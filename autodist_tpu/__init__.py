@@ -1,0 +1,34 @@
+"""autodist_tpu: a TPU-native distributed-training compiler.
+
+A from-scratch rebuild of the AutoDist design (strategy IR + compiler +
+runtime; see /root/reference) on jax/XLA: strategies assign per-variable
+synchronization (PS or AllReduce), partitioning, and placement; the
+compiler lowers them to shardings + collectives over a ``jax.sharding``
+device mesh, and a single fused XLA program per step replaces per-op graph
+rewriting.
+
+Typical use (mirrors reference README.md:11-25)::
+
+    import autodist_tpu as ad
+    autodist = ad.AutoDist(resource_spec_file, ad.AllReduce(128))
+    with autodist.scope():
+        W = ad.Variable(5.0, name='W')
+        b = ad.Variable(0.0, name='b')
+        x = ad.placeholder(shape=[None])
+        loss = ad.ops.reduce_mean(ad.ops.square(W * x + b - y))
+        train_op = ad.optimizers.SGD(0.01).minimize(loss)
+    sess = autodist.create_distributed_session()
+    sess.run([loss, train_op], {x: batch_x})
+"""
+from autodist_tpu.autodist import AutoDist, get_default_autodist  # noqa: F401
+from autodist_tpu.frontend import ops  # noqa: F401
+from autodist_tpu.frontend import optimizers  # noqa: F401
+from autodist_tpu.frontend.graph import (  # noqa: F401
+    Graph, Placeholder, Variable, gradients, placeholder)
+from autodist_tpu.graph_item import GraphItem  # noqa: F401
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: F401
+from autodist_tpu.strategy import (  # noqa: F401
+    PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
+    PSLoadBalancing, RandomAxisPartitionAR, UnevenPartitionedPS)
+
+__version__ = '0.1.0'

@@ -1,0 +1,191 @@
+"""User-facing engine: the :class:`AutoDist` object.
+
+Reference parity (``autodist/autodist.py:297-322``): construct with a
+resource-spec YAML + a strategy builder; capture the model under
+``.scope()``; then either ``create_distributed_session()`` (TF1-style) or
+``.function()`` (TF2-style). Chief/worker identity comes from the
+``AUTODIST_WORKER`` env flag (autodist.py:40-41): the chief builds and
+serializes the strategy, workers deserialize it by ``AUTODIST_STRATEGY_ID``
+(autodist.py:100-109) and every process independently lowers it
+(docs/design/architecture.rst:43-48).
+"""
+import atexit
+import os
+
+import numpy as np
+
+from autodist_tpu.const import ENV
+from autodist_tpu.frontend import graph as fe
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.parallel.mesh import mesh_from_strategy
+from autodist_tpu.parallel.plan import ExecutionPlan
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.runtime.session import Session
+from autodist_tpu.strategy import base as strategy_base
+from autodist_tpu.strategy.builders import PSLoadBalancing
+from autodist_tpu.utils import logging
+
+IS_AUTODIST_WORKER = bool(ENV.AUTODIST_WORKER.val)
+IS_AUTODIST_CHIEF = not IS_AUTODIST_WORKER
+
+_DEFAULT_AUTODIST = {}
+
+
+def set_default_autodist(o):
+    """Register the process's AutoDist instance (one per process)."""
+    if os.getpid() in _DEFAULT_AUTODIST:
+        raise NotImplementedError(
+            'Currently only one AutoDist instance is allowed in one process.')
+    _DEFAULT_AUTODIST[os.getpid()] = o
+
+
+def get_default_autodist():
+    return _DEFAULT_AUTODIST.get(os.getpid(), None)
+
+
+def _default_resource_info():
+    """Single-node spec from the locally visible jax devices."""
+    import jax
+    devs = jax.local_devices()
+    accel = [d.id for d in devs if d.platform not in ('cpu',)]
+    node = {'address': 'localhost', 'chief': True, 'cpus': [0],
+            'network_bandwidth': 100}
+    if accel:
+        node['tpus'] = accel
+    else:
+        node['gpus'] = list(range(len(devs)))  # virtual CPU devices
+    return {'nodes': [node]}
+
+
+class AutoDist:
+    """Distributed-training engine with minimal-code-change ergonomics.
+
+    Args:
+        resource_spec_file: path to a resource spec YAML (reference format,
+            plus optional ``tpus:`` / ``mesh:`` keys). Defaults to a
+            single-node spec over all local devices.
+        strategy_builder: a StrategyBuilder (default PSLoadBalancing, as in
+            the reference autodist.py:70).
+    """
+
+    def __init__(self, resource_spec_file=None, strategy_builder=None,
+                 resource_info=None):
+        set_default_autodist(self)
+        if resource_spec_file is not None:
+            self._resource_spec = ResourceSpec(
+                resource_file=resource_spec_file)
+        else:
+            self._resource_spec = ResourceSpec(
+                resource_info=resource_info or _default_resource_info())
+        self._strategy_builder = strategy_builder or PSLoadBalancing()
+        self._original_graph_item = None
+        self._transformed = None      # (strategy, mesh, plan)
+        self._session = None
+        self._cluster = Cluster(self._resource_spec)
+        self._built = False
+        # ad.function state
+        self._fn_cache = {}
+        self._ph_feed_index = {}
+
+    # -- capture -----------------------------------------------------------
+    def scope(self):
+        """Context manager capturing the code block to be distributed
+        (reference autodist.py:309-322)."""
+        self._original_graph_item = GraphItem(graph=fe.Graph())
+        return self._original_graph_item.graph
+
+    # -- strategy ----------------------------------------------------------
+    def build_strategy(self):
+        """Build the Strategy for the captured graph (autodist.py:91-98)."""
+        return self._strategy_builder.build(
+            self._original_graph_item, self._resource_spec)
+
+    def _build_or_load_strategy(self):
+        self._original_graph_item.prepare()
+        if IS_AUTODIST_CHIEF:
+            s = self.build_strategy()
+            s.serialize()
+        else:
+            strategy_id = ENV.AUTODIST_STRATEGY_ID.val
+            assert strategy_id, \
+                'Worker process needs AUTODIST_STRATEGY_ID set'
+            s = strategy_base.Strategy.deserialize(strategy_id)
+        return s
+
+    def _compile_strategy(self, strategy):
+        logging.debug('Raw strategy: %s', strategy)
+        compiled = strategy_base.StrategyCompiler(self._original_graph_item) \
+            .compile(strategy)
+        logging.info('Compiled strategy: %s', compiled)
+        return compiled
+
+    def _build(self):
+        strategy = self._build_or_load_strategy()
+        self._cluster.start()
+        compiled = self._compile_strategy(strategy)
+        mesh = mesh_from_strategy(compiled, self._resource_spec)
+        plan = ExecutionPlan(compiled, self._original_graph_item, mesh)
+        logging.info(plan.describe())
+        self._transformed = (compiled, mesh, plan)
+        self._built = True
+
+    def is_built(self):
+        return self._built
+
+    # -- execution ---------------------------------------------------------
+    def create_distributed_session(self):
+        """Create the distributed Session (reference autodist.py:191-198)."""
+        if not self.is_built():
+            self._build()
+        _, _, plan = self._transformed
+        self._session = Session(self._original_graph_item, plan,
+                                cluster=self._cluster)
+        atexit.register(self._session.close)
+        return self._session
+
+    def function(self, fn):
+        """TF2-style wrapper (reference autodist.py:269-289): ndarray args
+        become placeholders (first dim batch-polymorphic), the traced
+        fetches run through a distributed session on every call."""
+        def wrapper(*args, **kwargs):
+            key = id(fn)
+            if key not in self._fn_cache:
+                if self._fn_cache:
+                    raise NotImplementedError(
+                        "AutoDist currently only stably supports one "
+                        "'autodist.function' across the scope.")
+                self._fn_cache[key] = self._build_fn(fn, *args, **kwargs)
+            return self._fn_cache[key](*args, **kwargs)
+        return wrapper
+
+    def _build_fn(self, fn, *args, **kwargs):
+        ph_index = {}
+        args_ph, kwargs_ph = [], {}
+        for i, a in enumerate(args):
+            if isinstance(a, np.ndarray):
+                ph = fe.Placeholder((None,) + a.shape[1:],
+                                    a.dtype, name='arg%d' % i)
+                ph_index[ph] = i
+                args_ph.append(ph)
+            else:
+                args_ph.append(a)
+        for k, v in kwargs.items():
+            if isinstance(v, np.ndarray):
+                ph = fe.Placeholder((None,) + v.shape[1:], v.dtype,
+                                    name='kwarg_%s' % k)
+                ph_index[ph] = k
+                kwargs_ph[k] = ph
+            else:
+                kwargs_ph[k] = v
+        with self._original_graph_item.graph:
+            fetches = fn(*args_ph, **kwargs_ph)
+        session = self.create_distributed_session()
+
+        def run_fn(*args, **kwargs):
+            feed = {}
+            for ph, idx in ph_index.items():
+                feed[ph] = args[idx] if isinstance(idx, int) \
+                    else kwargs[idx]
+            return session.run(fetches, feed)
+        return run_fn

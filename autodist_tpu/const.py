@@ -1,0 +1,69 @@
+"""Constants and environment-flag system.
+
+TPU-native re-design of the reference's ``autodist/const.py`` (see
+/root/reference/autodist/const.py:32-89): working directories, name-scope
+prefixes, the port range used by the multi-process launcher, and a typed
+``ENV`` enum of environment flags that are explicitly propagated to worker
+processes by the coordinator.
+"""
+import os
+from enum import Enum
+
+# Working directories ------------------------------------------------------
+# Hyphenated on purpose: an importable name here would shadow the package
+# as a namespace package for any process whose cwd is /tmp.
+DEFAULT_WORKING_DIR = '/tmp/autodist-tpu'
+DEFAULT_SERIALIZATION_DIR = os.path.join(DEFAULT_WORKING_DIR, 'strategies')
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, 'logs')
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, 'traces')
+DEFAULT_GRAPH_DUMP_DIR = os.path.join(DEFAULT_WORKING_DIR, 'graphs')
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, 'checkpoints')
+
+# Port range for the coordination service / distributed runtime
+# (reference uses 15000-16000 for tf.Server grpc ports, const.py:38).
+DEFAULT_PORT_RANGE = iter(range(15000, 16000))
+DEFAULT_COORD_PORT = 14999
+
+# Mesh axis names used by the strategy compiler. The reference only has a
+# replica ("data") dimension; the TPU rebuild exposes the full set.
+AXIS_DATA = 'data'
+AXIS_MODEL = 'model'
+AXIS_PIPELINE = 'pipe'
+AXIS_SEQUENCE = 'seq'
+AXIS_EXPERT = 'expert'
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_PIPELINE, AXIS_SEQUENCE, AXIS_EXPERT)
+
+# Name-scope prefixes (parity with const.py:41-51).
+AUTODIST_PREFIX = 'AutoDist-'
+AUTODIST_REPLICA_PREFIX = AUTODIST_PREFIX + 'Replica-'
+AUTODIST_TO_DELETE_SCOPE = 'to-delete'
+
+MAX_INT32 = 2 ** 31 - 1
+
+
+class ENV(Enum):
+    """Typed environment flags, each with a default-producing lambda.
+
+    Mirrors reference const.py:55-89. ``val`` parses the raw env var into a
+    typed value. Flags are explicitly forwarded to launched worker
+    processes by :mod:`autodist_tpu.runtime.coordinator`.
+    """
+
+    AUTODIST_WORKER = (lambda v: v if v else '',)                    # worker address; empty => chief
+    AUTODIST_STRATEGY_ID = (lambda v: v if v else '',)               # strategy id to load on workers
+    AUTODIST_MIN_LOG_LEVEL = (lambda v: v if v else 'INFO',)
+    AUTODIST_IS_TESTING = (lambda v: (v == 'True' or v == '1'),)
+    AUTODIST_DEBUG_REMOTE = (lambda v: (v == 'True' or v == '1'),)
+    SYS_DATA_PATH = (lambda v: v if v else '',)
+    SYS_RESOURCE_PATH = (lambda v: v if v else '',)
+    # TPU-native additions:
+    AUTODIST_PROCESS_ID = (lambda v: int(v) if v else 0,)            # jax.distributed process index
+    AUTODIST_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
+    AUTODIST_COORDINATOR_ADDR = (lambda v: v if v else '',)          # host:port for jax.distributed
+    AUTODIST_COORD_SERVICE_ADDR = (lambda v: v if v else '',)        # host:port for native coord service
+    AUTODIST_DUMP_GRAPHS = (lambda v: (v == 'True' or v == '1'),)    # dump jaxpr/HLO per phase
+
+    @property
+    def val(self):
+        """Return the typed value of this environment flag."""
+        return self.value[0](os.environ.get(self.name))

@@ -1,0 +1,426 @@
+"""Symbolic capture frontend.
+
+The reference captures the user's model as a ``tf.Graph`` plus
+monkey-patched optimizer hooks (reference ``autodist/graph_item.py:73-109``,
+``autodist/patch.py:80-88``). The TPU-native equivalent cannot lean on TF
+graph mode, so this module provides a *minimal symbolic tensor DSL*:
+
+- :class:`Placeholder`, :class:`Variable` reads, :class:`Const` and generic
+  lifted-``jnp`` :class:`Op` nodes form a DAG while user code runs inside
+  ``ad.scope()``;
+- :class:`Gradients` nodes capture ``ad.gradients(loss, vars)`` requests;
+- optimizer ``apply_gradients`` creates an :class:`ApplyGradients` train-op
+  node and records grad→target pairs on the graph (same bookkeeping the
+  reference does via monkey-patching);
+- at session time the whole DAG is *interpreted once inside a jax trace*
+  (:func:`evaluate`), so the executed artifact is a single fused XLA
+  program — graph surgery is replaced by functional re-interpretation.
+
+Everything here is build-time only; no per-step Python cost beyond the
+jitted function dispatch.
+"""
+import itertools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_GRAPH_STACK = threading.local()
+
+
+def _stack():
+    if not hasattr(_GRAPH_STACK, 'stack'):
+        _GRAPH_STACK.stack = []
+    return _GRAPH_STACK.stack
+
+
+def get_default_graph():
+    """Return the innermost active Graph, creating a global one if needed."""
+    stack = _stack()
+    if not stack:
+        stack.append(Graph())
+    return stack[-1]
+
+
+class Graph:
+    """A captured symbolic program: nodes, variables, grad→target pairs."""
+
+    def __init__(self):
+        self._name_counter = itertools.count()
+        self.variables = {}            # name -> Variable
+        self.nodes = []
+        self.grad_target_pairs = {}    # grad node -> Variable
+        self.optimizers = []           # captured (class, args, kwargs)
+        self.savers = []               # registered Saver objects
+
+    def unique_name(self, prefix):
+        return '%s_%d' % (prefix, next(self._name_counter))
+
+    def register_variable(self, var):
+        if var.name in self.variables:
+            raise ValueError('Duplicate variable name %r' % var.name)
+        self.variables[var.name] = var
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+    def as_default(self):
+        return self
+
+
+class SymTensor:
+    """Base class for all symbolic nodes. Supports jnp-style operators."""
+
+    def __init__(self, shape=None, dtype=None, name=None):
+        self.graph = get_default_graph()
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name or self.graph.unique_name(type(self).__name__)
+        self.graph.nodes.append(self)
+
+    # -- operator sugar ---------------------------------------------------
+    def _binop(self, fn, other, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return Op(fn, [a, b])
+
+    def __add__(self, o):
+        return self._binop(jnp.add, o)
+
+    def __radd__(self, o):
+        return self._binop(jnp.add, o, True)
+
+    def __sub__(self, o):
+        return self._binop(jnp.subtract, o)
+
+    def __rsub__(self, o):
+        return self._binop(jnp.subtract, o, True)
+
+    def __mul__(self, o):
+        return self._binop(jnp.multiply, o)
+
+    def __rmul__(self, o):
+        return self._binop(jnp.multiply, o, True)
+
+    def __truediv__(self, o):
+        return self._binop(jnp.divide, o)
+
+    def __rtruediv__(self, o):
+        return self._binop(jnp.divide, o, True)
+
+    def __pow__(self, o):
+        return self._binop(jnp.power, o)
+
+    def __matmul__(self, o):
+        return self._binop(jnp.matmul, o)
+
+    def __rmatmul__(self, o):
+        return self._binop(jnp.matmul, o, True)
+
+    def __neg__(self):
+        return Op(jnp.negative, [self])
+
+    def __getitem__(self, idx):
+        return Op(lambda x: x[idx], [self])
+
+    @property
+    def T(self):  # noqa: N802 - numpy-style transpose property
+        return Op(jnp.transpose, [self])
+
+    def __repr__(self):
+        return '<%s %r shape=%s>' % (type(self).__name__, self.name,
+                                     self.shape)
+
+
+class Placeholder(SymTensor):
+    """Feedable input; polymorphic batch dim expressed as None."""
+
+    def __init__(self, shape=None, dtype=jnp.float32, name=None):
+        super().__init__(shape, dtype, name)
+
+
+class Const(SymTensor):
+    """Embedded constant value."""
+
+    def __init__(self, value, name=None):
+        value = np.asarray(value)
+        super().__init__(value.shape, value.dtype, name)
+        self.value = value
+
+
+class Op(SymTensor):
+    """Generic lifted op: ``fn(*inputs, **kwargs)`` where inputs may mix
+    SymTensors and python literals."""
+
+    def __init__(self, fn, inputs, kwargs=None, name=None):
+        super().__init__(None, None, name)
+        self.fn = fn
+        self.inputs = list(inputs)
+        self.kwargs = kwargs or {}
+
+
+class VariableRead(SymTensor):
+    """Read of a Variable's current value at step entry."""
+
+    def __init__(self, variable):
+        super().__init__(variable.init_value.shape,
+                         variable.init_value.dtype,
+                         variable.name + '/read')
+        self.variable = variable
+
+
+class Gradients(SymTensor):
+    """``ad.gradients(loss, sources)``: list-valued node.
+
+    Evaluated by re-tracing the loss subgraph as a function of the source
+    variables and calling ``jax.grad`` — the functional analogue of the
+    reference's reliance on TF's symbolic autodiff.
+    """
+
+    def __init__(self, loss, sources, name=None):
+        super().__init__(None, None, name)
+        self.loss = loss
+        self.sources = list(sources)
+
+    def __iter__(self):
+        return iter([GradientSlice(self, i)
+                     for i in range(len(self.sources))])
+
+    def __len__(self):
+        return len(self.sources)
+
+
+class GradientSlice(SymTensor):
+    """The i-th output of a Gradients node."""
+
+    def __init__(self, grads, index):
+        super().__init__(None, None,
+                         '%s/grad_%d' % (grads.name, index))
+        self.grads = grads
+        self.index = index
+
+
+class ApplyGradients(SymTensor):
+    """Train op: applying an optimizer update to variables.
+
+    Mirrors the reference's optimizer-capture: creating this node records
+    grad→target pairs on the graph (graph_item.py:93-109) and the optimizer
+    spec (graph_item.py:73-90) for the strategy layer to inspect.
+    """
+
+    def __init__(self, optimizer, grads_and_vars, name=None):
+        super().__init__((), None, name or
+                         get_default_graph().unique_name('ApplyGradients'))
+        self.optimizer = optimizer
+        self.grads_and_vars = list(grads_and_vars)
+        g = self.graph
+        for grad, var in self.grads_and_vars:
+            g.grad_target_pairs[grad] = var
+
+
+class Variable:
+    """A mutable training parameter.
+
+    Not itself a node: arithmetic on it reads the current value via a
+    :class:`VariableRead`. State lives in the Session, threaded through the
+    jitted step function — the functional replacement for TF resource
+    variables.
+    """
+
+    def __init__(self, initial_value, name=None, trainable=True,
+                 dtype=None):
+        self.graph = get_default_graph()
+        init = np.asarray(initial_value, dtype=dtype)
+        if init.dtype == np.float64:
+            init = init.astype(np.float32)  # TPU-native default
+        self.init_value = init
+        self.name = name or self.graph.unique_name('Variable')
+        self.trainable = trainable
+        # Set when the variable is consumed by an embedding lookup — the
+        # analogue of the reference's IndexedSlices-gradient detection
+        # (partitioned_ps_strategy.py / parallax_strategy.py sparse checks).
+        self.sparse_read = False
+        self.graph.register_variable(self)
+        self._read = None
+
+    @property
+    def shape(self):
+        return self.init_value.shape
+
+    @property
+    def dtype(self):
+        return self.init_value.dtype
+
+    @property
+    def nbytes(self):
+        return int(self.init_value.nbytes)
+
+    def read(self):
+        if self._read is None:
+            self._read = VariableRead(self)
+        return self._read
+
+    # operator sugar delegates to the read node
+    def __add__(self, o):
+        return self.read() + o
+
+    def __radd__(self, o):
+        return o + self.read()
+
+    def __sub__(self, o):
+        return self.read() - o
+
+    def __rsub__(self, o):
+        return o - self.read()
+
+    def __mul__(self, o):
+        return self.read() * o
+
+    def __rmul__(self, o):
+        return o * self.read()
+
+    def __truediv__(self, o):
+        return self.read() / o
+
+    def __rtruediv__(self, o):
+        return o / self.read()
+
+    def __pow__(self, o):
+        return self.read() ** o
+
+    def __matmul__(self, o):
+        return self.read() @ o
+
+    def __rmatmul__(self, o):
+        return o @ self.read()
+
+    def __neg__(self):
+        return -self.read()
+
+    def __getitem__(self, idx):
+        return self.read()[idx]
+
+    @property
+    def T(self):  # noqa: N802
+        return self.read().T
+
+    def __repr__(self):
+        return '<Variable %r shape=%s dtype=%s>' % (
+            self.name, self.shape, self.dtype)
+
+
+def placeholder(shape=None, dtype=jnp.float32, name=None):
+    """Create a feedable input node (parity with tf.placeholder)."""
+    return Placeholder(shape, dtype, name)
+
+
+def gradients(loss, sources):
+    """Symbolic gradients of ``loss`` w.r.t. ``sources`` (Variables)."""
+    for s in sources:
+        if not isinstance(s, Variable):
+            raise TypeError('gradients sources must be Variables, got %r'
+                            % (s,))
+    return Gradients(loss, sources)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation: interpret the DAG inside a jax trace.
+# ---------------------------------------------------------------------------
+
+class Env:
+    """One evaluation environment: variable values + feeds + memo table."""
+
+    def __init__(self, var_values, feeds, grad_sync_fn=None,
+                 opt_state=None, aux_state=None):
+        self.var_values = var_values      # {var name: jax value}
+        self.feeds = feeds                # {Placeholder node: jax value}
+        self.memo = {}
+        # Hook applied to the full evaluated gradient list of a Gradients
+        # node: ``fn(sources, grads, env) -> synced grads``. The strategy
+        # compiler injects per-variable synchronization here (psum /
+        # compressor / group-fused collectives / reduce-scatter) when
+        # running inside shard_map.
+        self.grad_sync_fn = grad_sync_fn
+        self.opt_state = opt_state or {}  # {optimizer uid: slot pytree}
+        self.aux_state = aux_state or {}  # e.g. compressor residuals
+        self.var_shards = {}              # local shards of ZeRO-sharded vars
+        self.updates = {}                 # {var name: new value}
+        self.opt_updates = {}             # {optimizer uid: new slot pytree}
+        self.aux_updates = {}             # {aux key: new value}
+
+
+def evaluate(node, env):
+    """Interpret one node under ``env`` (memoized)."""
+    if isinstance(node, Variable):
+        node = node.read()
+    key = id(node)
+    if key in env.memo:
+        return env.memo[key]
+    out = _eval(node, env)
+    env.memo[key] = out
+    return out
+
+
+def _resolve(x, env):
+    if isinstance(x, (SymTensor, Variable)):
+        return evaluate(x, env)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_resolve(v, env) for v in x)
+    return x
+
+
+def _eval(node, env):
+    if isinstance(node, Placeholder):
+        if node not in env.feeds:
+            raise KeyError('Placeholder %r was not fed' % node.name)
+        return env.feeds[node]
+    if isinstance(node, Const):
+        return jnp.asarray(node.value)
+    if isinstance(node, VariableRead):
+        return env.var_values[node.variable.name]
+    if isinstance(node, Op):
+        args = [_resolve(a, env) for a in node.inputs]
+        kwargs = {k: _resolve(v, env) for k, v in node.kwargs.items()}
+        return node.fn(*args, **kwargs)
+    if isinstance(node, Gradients):
+        return _eval_gradients(node, env)
+    if isinstance(node, GradientSlice):
+        return evaluate(node.grads, env)[node.index]
+    if isinstance(node, ApplyGradients):
+        return _eval_apply(node, env)
+    raise TypeError('Cannot evaluate node %r' % (node,))
+
+
+def _eval_gradients(node, env):
+    names = [v.name for v in node.sources]
+
+    def loss_of(vals):
+        sub = dict(env.var_values)
+        sub.update(dict(zip(names, vals)))
+        sub_env = Env(sub, env.feeds, None, env.opt_state, env.aux_state)
+        loss = evaluate(node.loss, sub_env)
+        return jnp.asarray(loss, dtype=jnp.float32) \
+            if loss.dtype not in (jnp.float32, jnp.float64) else loss
+
+    vals = [env.var_values[n] for n in names]
+    loss_val, grads = jax.value_and_grad(loss_of)(vals)
+    # Share the forward pass with a direct fetch of the loss node.
+    env.memo.setdefault(id(node.loss), loss_val)
+    grads = list(grads)
+    if env.grad_sync_fn is not None:
+        grads = env.grad_sync_fn(node.sources, grads, env)
+    return grads
+
+
+def _eval_apply(node, env):
+    gv = []
+    for grad, var in node.grads_and_vars:
+        gv.append((evaluate(grad, env), var))
+    new_values = node.optimizer._apply(gv, env)
+    for var, val in new_values.items():
+        env.updates[var.name] = val
+    return jnp.zeros((), jnp.int32)  # train-op sentinel value

@@ -1,0 +1,184 @@
+"""Lifted numeric ops for the symbolic frontend.
+
+Any ``jnp`` function can be lifted with :func:`lift`; the common ones used
+by the reference's example models (reduce_mean, square, matmul, embedding
+lookups, losses — see /root/reference/examples and tests/integration/cases)
+are exported directly.
+
+``embedding_lookup`` additionally marks its table Variable as
+``sparse_read`` — the analogue of the reference's IndexedSlices-gradient
+detection that strategy builders use to route sparse variables to PS
+(parallax_strategy.py:38-70, partitioner.py:660-684).
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.frontend import graph as fe
+
+
+def lift(fn):
+    """Lift a jax-traceable function to operate on SymTensors."""
+    def lifted(*args, **kwargs):
+        return fe.Op(fn, list(args), kwargs)
+    lifted.__name__ = getattr(fn, '__name__', 'lifted')
+    return lifted
+
+
+def _sym(fn, *args, **kwargs):
+    return fe.Op(fn, list(args), kwargs)
+
+
+def constant(value, name=None):
+    return fe.Const(value, name=name)
+
+
+# Elementwise / reductions -------------------------------------------------
+def square(x):
+    return _sym(jnp.square, x)
+
+
+def sqrt(x):
+    return _sym(jnp.sqrt, x)
+
+
+def exp(x):
+    return _sym(jnp.exp, x)
+
+
+def log(x):
+    return _sym(jnp.log, x)
+
+
+def tanh(x):
+    return _sym(jnp.tanh, x)
+
+
+def sigmoid(x):
+    return _sym(jax.nn.sigmoid, x)
+
+
+def relu(x):
+    return _sym(jax.nn.relu, x)
+
+
+def softmax(x, axis=-1):
+    return _sym(jax.nn.softmax, x, axis=axis)
+
+
+def abs(x):  # noqa: A001 - mirrors tf.abs
+    return _sym(jnp.abs, x)
+
+
+def reduce_mean(x, axis=None):
+    return _sym(jnp.mean, x, axis=axis)
+
+
+def reduce_sum(x, axis=None):
+    return _sym(jnp.sum, x, axis=axis)
+
+
+def reduce_max(x, axis=None):
+    return _sym(jnp.max, x, axis=axis)
+
+
+def argmax(x, axis=-1):
+    return _sym(jnp.argmax, x, axis=axis)
+
+
+def cast(x, dtype):
+    return _sym(lambda v: jnp.asarray(v, dtype=dtype), x)
+
+
+def reshape(x, shape):
+    return _sym(jnp.reshape, x, shape)
+
+
+def transpose(x, axes=None):
+    return _sym(jnp.transpose, x, axes=axes)
+
+
+def concat(xs, axis=0):
+    return fe.Op(lambda *vs: jnp.concatenate(vs, axis=axis), list(xs))
+
+
+def stack(xs, axis=0):
+    return fe.Op(lambda *vs: jnp.stack(vs, axis=axis), list(xs))
+
+
+def matmul(a, b):
+    return _sym(jnp.matmul, a, b)
+
+
+def one_hot(x, depth):
+    return _sym(jax.nn.one_hot, x, depth)
+
+
+def squeeze(x, axis=None):
+    return _sym(jnp.squeeze, x, axis=axis)
+
+
+def expand_dims(x, axis):
+    return _sym(jnp.expand_dims, x, axis)
+
+
+# Losses -------------------------------------------------------------------
+def sigmoid_cross_entropy_with_logits(labels, logits):
+    def fn(labels, logits):
+        return jnp.maximum(logits, 0) - logits * labels + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _sym(fn, labels, logits)
+
+
+def sparse_softmax_cross_entropy_with_logits(labels, logits):
+    def fn(labels, logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(
+            logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return _sym(fn, labels, logits)
+
+
+def softmax_cross_entropy_with_logits(labels, logits):
+    def fn(labels, logits):
+        return -jnp.sum(labels * jax.nn.log_softmax(logits, -1), axis=-1)
+    return _sym(fn, labels, logits)
+
+
+# Embeddings ---------------------------------------------------------------
+def gather(params, indices, axis=0):
+    """Index gather; marks a Variable source as sparse-read so strategy
+    builders can treat its gradient as sparse (reference: IndexedSlices
+    through ``embedding_lookup_v2``, partitioner.py:576-602)."""
+    if isinstance(params, fe.Variable):
+        params.sparse_read = True
+    return _sym(lambda p, i: jnp.take(p, i.astype(jnp.int32), axis=axis),
+                params, indices)
+
+
+def embedding_lookup(params, ids):
+    """Row gather from an embedding table Variable."""
+    return gather(params, ids, axis=0)
+
+
+# Control flow -------------------------------------------------------------
+def while_loop(cond_fn, body_fn, init):
+    """Lifted ``lax.while_loop`` over symbolic carries.
+
+    The condition/body are jax-level functions applied to traced values —
+    the compiler-friendly replacement for the reference's TF v1 while_loop
+    handling (case c4, control-flow contexts in replicator.py:92-103).
+    """
+    def fn(*vals):
+        return jax.lax.while_loop(cond_fn, body_fn, tuple(vals))
+    return fe.Op(fn, list(init))
+
+
+def cond(pred, true_fn, false_fn, operands):
+    def fn(p, *vals):
+        return jax.lax.cond(p, true_fn, false_fn, *vals)
+    return fe.Op(fn, [pred] + list(operands))
+
+
+def scan(body_fn, init, xs):
+    def fn(c, x):
+        return jax.lax.scan(body_fn, c, x)
+    return _sym(fn, init, xs)

@@ -1,0 +1,170 @@
+"""Optimizers for the symbolic frontend, backed by optax.
+
+The reference monkey-patches every TF ``OptimizerV1/V2`` subclass to capture
+constructor args and grad→target pairs (reference ``autodist/patch.py:80-88``,
+``autodist/graph_item.py:73-109``) so the partitioner can *recreate* the
+optimizer per variable shard (``autodist/kernel/partitioner.py:570-573``).
+
+The TPU-native design needs no patching: optimizers are explicit objects
+whose slot state is a pytree threaded through the jitted step. Capture is
+structural — constructing an optimizer registers ``(class, args, kwargs)``
+on the active graph, and ``apply_gradients`` records grad→target pairs —
+and per-shard recreation is free because optax transforms are applied
+per-leaf.
+"""
+import itertools
+
+import jax.numpy as jnp
+import optax
+
+from autodist_tpu.frontend import graph as fe
+
+_UID = itertools.count()
+
+
+class Optimizer:
+    """Wraps an optax GradientTransformation, applied per variable leaf.
+
+    Per-leaf (rather than whole-pytree) application is what lets the
+    strategy layer shard each variable's slot state with the same
+    PartitionSpec as the variable itself (ZeRO-style PS realization).
+    """
+
+    def __init__(self, tx, name=None, _capture=None):
+        self.uid = 'opt_%d' % next(_UID)
+        self.tx = tx
+        self.name = name or type(self).__name__
+        g = fe.get_default_graph()
+        g.optimizers.append(
+            _capture or (type(self).__name__, (), {}))
+
+    # -- symbolic API ------------------------------------------------------
+    def apply_gradients(self, grads_and_vars):
+        """Create the train-op node (records grad→target pairs)."""
+        return fe.ApplyGradients(self, list(grads_and_vars))
+
+    def minimize(self, loss, var_list=None):
+        if var_list is None:
+            var_list = [v for v in fe.get_default_graph().variables.values()
+                        if v.trainable]
+        grads = fe.gradients(loss, var_list)
+        return self.apply_gradients(zip(grads, var_list))
+
+    # -- state management (called by the Session / compiler) --------------
+    def init_slot_state(self, variables, var_values):
+        """Per-variable optax slot state: {var name: leaf state}."""
+        return {v.name: self.tx.init(jnp.asarray(var_values[v.name]))
+                for v in variables}
+
+    def _apply(self, grads_and_vars, env):
+        """Evaluate the update inside the step trace. Returns new values.
+
+        Gradients arriving as :class:`~autodist_tpu.parallel.plan.
+        ShardedGrad` update only the local (ZeRO) shard of the variable and
+        its slot state; the session's out-shardings keep the result
+        distributed.
+        """
+        from autodist_tpu.parallel.plan import ShardedGrad
+        slots = dict(env.opt_state.get(self.uid, {}))
+        new_values = {}
+        for grad, var in grads_and_vars:
+            state = slots[var.name]
+            if isinstance(grad, ShardedGrad):
+                value = env.var_shards[var.name]
+                update, new_state = self.tx.update(grad.value, state, value)
+            else:
+                value = env.var_values[var.name]
+                update, new_state = self.tx.update(grad, state, value)
+            new_values[var] = value + update
+            slots[var.name] = new_state
+        env.opt_updates[self.uid] = slots
+        return new_values
+
+
+class SGD(Optimizer):
+    """Plain / momentum / Nesterov SGD (reference test matrix: GradientDescent,
+    Momentum; tests/test_graph_item.py:55-86)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, nesterov=False,
+                 name=None):
+        super().__init__(
+            optax.sgd(learning_rate, momentum=momentum or None,
+                      nesterov=nesterov),
+            name, _capture=('SGD', (learning_rate,),
+                            {'momentum': momentum, 'nesterov': nesterov}))
+
+
+GradientDescent = SGD
+
+
+class Momentum(SGD):
+    def __init__(self, learning_rate=0.01, momentum=0.9, **kw):
+        super().__init__(learning_rate, momentum=momentum, **kw)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.adam(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon),
+            name, _capture=('Adam', (learning_rate,),
+                            {'beta_1': beta_1, 'beta_2': beta_2,
+                             'epsilon': epsilon}))
+
+
+class AdamW(Optimizer):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, beta_1=0.9,
+                 beta_2=0.999, epsilon=1e-7, name=None):
+        super().__init__(
+            optax.adamw(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon,
+                        weight_decay=weight_decay),
+            name, _capture=('AdamW', (learning_rate,),
+                            {'weight_decay': weight_decay}))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, initial_accumulator_value=0.1,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.adagrad(learning_rate,
+                          initial_accumulator_value=initial_accumulator_value,
+                          eps=epsilon),
+            name, _capture=('Adagrad', (learning_rate,), {}))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.0,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.rmsprop(learning_rate, decay=rho, eps=epsilon,
+                          momentum=momentum or None),
+            name, _capture=('RMSProp', (learning_rate,),
+                            {'rho': rho, 'momentum': momentum}))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-7,
+                 name=None):
+        super().__init__(
+            optax.adadelta(learning_rate, rho=rho, eps=epsilon),
+            name, _capture=('Adadelta', (learning_rate,), {}))
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.adamax(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon),
+            name, _capture=('Adamax', (learning_rate,), {}))
+
+
+class LAMB(Optimizer):
+    """Layer-wise adaptive optimizer used by the BERT-large benchmark."""
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.0, beta_1=0.9,
+                 beta_2=0.999, epsilon=1e-6, name=None):
+        super().__init__(
+            optax.lamb(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon,
+                       weight_decay=weight_decay),
+            name, _capture=('LAMB', (learning_rate,),
+                            {'weight_decay': weight_decay}))

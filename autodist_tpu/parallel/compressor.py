@@ -1,0 +1,141 @@
+"""Gradient compressors wrapping the all-reduce collective.
+
+Parity with reference ``autodist/kernel/synchronization/compressor.py``:
+``NoneCompressor`` (:146-166), ``HorovodCompressor`` (fp16 cast, :169-201),
+``HorovodCompressorEF`` (error feedback, :120-143 + :204-205). PowerSGD is
+commented out in the reference (:208-284); here it is implemented for real
+as a low-rank compressor (round-robin power iteration) since low-precision
++ low-rank collectives are where TPU ICI bandwidth wins come from.
+
+A compressor transforms the *local* gradient before the collective and
+inverse-transforms after; persistent state (error-feedback residual,
+PowerSGD ``q`` matrix) lives in the session's aux-state pytree, threaded
+through the jitted step.
+"""
+import jax.numpy as jnp
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def create(name, var_name):
+    """Factory by proto enum name (reference Compressor.create)."""
+    if name not in _REGISTRY:
+        raise ValueError('Unknown compressor %r (have %s)' %
+                         (name, sorted(_REGISTRY)))
+    return _REGISTRY[name](var_name)
+
+
+class Compressor:
+    """Base: ``reduce(grad, env, reduce_fn) -> averaged gradient``."""
+
+    def __init__(self, var_name):
+        self.var_name = var_name
+
+    def init_state(self, var_value):
+        """Aux-state pytree for this compressor ({} if stateless)."""
+        return {}
+
+    def reduce(self, grad, env, reduce_fn):
+        raise NotImplementedError
+
+
+@register
+class NoneCompressor(Compressor):
+    """Straight all-reduce."""
+
+    def reduce(self, grad, env, reduce_fn):
+        return reduce_fn(grad)
+
+
+@register
+class HorovodCompressor(Compressor):
+    """Cast to bfloat16 for the wire, cast back after.
+
+    The reference casts fp32→fp16 (compressor.py:169-201); bfloat16 is the
+    TPU-native low-precision wire format (no loss-scaling needed).
+    """
+
+    def reduce(self, grad, env, reduce_fn):
+        orig = grad.dtype
+        if orig == jnp.float32:
+            return reduce_fn(grad.astype(jnp.bfloat16)).astype(orig)
+        return reduce_fn(grad)
+
+
+@register
+class HorovodCompressorEF(Compressor):
+    """Low-precision all-reduce with error feedback.
+
+    The quantization residual is carried to the next step and added back
+    before compression (compressor.py:120-143), making the compression
+    unbiased over time.
+    """
+
+    def init_state(self, var_value):
+        return {'residual': jnp.zeros(var_value.shape, jnp.float32)}
+
+    def reduce(self, grad, env, reduce_fn):
+        key = 'compressor/%s' % self.var_name
+        if grad.dtype != jnp.float32:
+            return reduce_fn(grad)
+        residual = env.aux_state[key]['residual']
+        compensated = grad + residual
+        compressed = compensated.astype(jnp.bfloat16)
+        env.aux_updates[key] = {
+            'residual': compensated - compressed.astype(jnp.float32)}
+        return reduce_fn(compressed).astype(jnp.float32)
+
+
+@register
+class PowerSGDCompressor(Compressor):
+    """Rank-``r`` PowerSGD (arXiv:1905.13727) with error feedback.
+
+    The gradient matrix ``M (n×m)`` is approximated as ``P Qᵀ`` where
+    ``P = M Q`` is all-reduced (and orthogonalized) and ``Q = Mᵀ P`` is
+    all-reduced; only ``P``/``Q`` cross the wire. Falls back to plain
+    all-reduce for rank<2 tensors.
+    """
+
+    RANK = 2
+
+    def init_state(self, var_value):
+        if var_value.ndim < 2:
+            return {}
+        n = int(var_value.shape[0])
+        m = 1
+        for d in var_value.shape[1:]:
+            m *= int(d)
+        # Deterministic init (stable across processes — crc32, not the
+        # salted builtin hash); orthogonalized on first use.
+        import zlib
+        import numpy as np
+        rng = np.random.RandomState(
+            zlib.crc32(self.var_name.encode()) % (2 ** 31))
+        q = rng.standard_normal((m, self.RANK)).astype('float32')
+        return {'q': jnp.asarray(q),
+                'residual': jnp.zeros((n, m), jnp.float32)}
+
+    @staticmethod
+    def _orthogonalize(m):
+        q, _ = jnp.linalg.qr(m)
+        return q
+
+    def reduce(self, grad, env, reduce_fn):
+        if grad.ndim < 2:
+            return reduce_fn(grad)
+        key = 'compressor/%s' % self.var_name
+        state = env.aux_state[key]
+        shape = grad.shape
+        mat = grad.reshape(shape[0], -1) + state['residual']
+        q = state['q']
+        p = reduce_fn(mat @ q)
+        p = self._orthogonalize(p)
+        new_q = reduce_fn(mat.T @ p)
+        approx = p @ new_q.T
+        env.aux_updates[key] = {'q': new_q, 'residual': mat - approx}
+        return approx.reshape(shape)

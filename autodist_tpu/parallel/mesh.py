@@ -1,0 +1,71 @@
+"""Device mesh construction.
+
+The reference resolves abstract device strings to TF device strings and
+lets TF's placer handle the rest (``autodist/kernel/device/resolver.py:
+47-67``). The TPU-native equivalent builds a ``jax.sharding.Mesh`` whose
+axes the strategy compiler binds shardings onto; XLA then handles placement
+and collective lowering over ICI/DCN.
+
+Axes follow :data:`autodist_tpu.const.ALL_AXES`:
+
+``data``  — replica axis (the only axis the reference has),
+``model`` — tensor parallelism, ``pipe`` — pipeline stages,
+``seq``   — sequence/context parallelism (ring attention / Ulysses),
+``expert``— MoE expert parallelism.
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from autodist_tpu.const import (ALL_AXES, AXIS_DATA)
+from autodist_tpu.utils import logging
+
+
+def build_mesh(num_replicas=None, axis_sizes=None, devices=None):
+    """Build the framework mesh.
+
+    Args:
+        num_replicas: size of the ``data`` axis when no explicit
+            ``axis_sizes`` is given. Defaults to all visible devices.
+        axis_sizes: ordered dict-like {axis_name: size}; their product must
+            divide the available device count. Axes of size 1 are kept so
+            strategies can always reference the full axis set.
+        devices: explicit device list (defaults to ``jax.devices()``).
+
+    Returns:
+        jax.sharding.Mesh
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_sizes:
+        names = [a for a in ALL_AXES if a in axis_sizes]
+        # preserve any user-defined extra axes in given order
+        names += [a for a in axis_sizes if a not in names]
+        sizes = [int(axis_sizes[a]) for a in names]
+    else:
+        n = num_replicas if num_replicas else len(devices)
+        names, sizes = [AXIS_DATA], [int(n)]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            'Mesh wants %d devices (%s) but only %d are visible' %
+            (total, dict(zip(names, sizes)), len(devices)))
+    if total < len(devices):
+        logging.debug('Using %d of %d visible devices for the mesh',
+                      total, len(devices))
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def mesh_from_strategy(strategy, resource_spec=None, devices=None):
+    """Mesh for a compiled reference-style strategy: 1-D ``data`` axis sized
+    by the replica list, optionally extended by resource-spec mesh hints."""
+    hints = dict(resource_spec.mesh_hint) if resource_spec is not None \
+        else {}
+    devices = list(devices if devices is not None else jax.devices())
+    n_replicas = len(strategy.graph_config.replicas) or len(devices)
+    n_replicas = min(n_replicas, len(devices))
+    if hints:
+        hints.setdefault(AXIS_DATA, n_replicas)
+        return build_mesh(axis_sizes=hints, devices=devices)
+    return build_mesh(num_replicas=n_replicas, devices=devices)

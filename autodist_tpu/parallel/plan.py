@@ -1,0 +1,250 @@
+"""Execution plan: lower a compiled Strategy onto the device mesh.
+
+This is the TPU-native replacement for the reference's graph-transformer
+backend (``autodist/kernel/graph_transformer.py:55-92`` and the
+synchronizer kernels). Where the reference rewrites a TF graph op-by-op —
+replicating subgraphs, splicing collective ops, placing variables — the
+rebuild expresses the same per-variable decisions *functionally*:
+
+- **Replication** (replicator.py:73-156) is SPMD: the captured step is
+  interpreted once inside ``shard_map`` over the ``data`` mesh axis.
+- **AllReduceSynchronizer** (all_reduce_synchronizer.py:102-130) becomes a
+  ``jax.lax.pmean`` over ``data``, optionally compressor-wrapped, with
+  same-``group`` variables fused into one flat-bucket collective (the
+  scoped-allocator equivalent, runner.py:33-46).
+- **PSSynchronizer** (ps_synchronizer.py) in synchronous mode is
+  numerically an average; its *placement* semantics (variables and
+  optimizer slots living on reduction destinations) lower to ZeRO-style
+  sharded state over the mesh with gather-on-read / scatter-on-update.
+  Partitioned vars shard along the strategy's partition axis.
+- Collective "spec" NCCL/RING collapses into XLA's ICI algorithm choice;
+  ``RING`` forces an explicit ppermute ring (useful over DCN).
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import AXIS_DATA
+from autodist_tpu.parallel import compressor as comp
+from autodist_tpu.strategy.base import (AllReduceSynchronizer,
+                                        PSSynchronizer)
+from autodist_tpu.utils import logging
+
+
+def ring_all_reduce(x, axis_name):
+    """Explicit ring all-reduce via ppermute (reference RING spec).
+
+    Bandwidth-optimal over a 1-D ring; XLA usually does better on ICI, so
+    this is only used when a strategy forces ``spec='RING'``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    out = x
+    chunk = x
+    for _ in range(n - 1):
+        chunk = jax.lax.ppermute(
+            chunk, axis_name,
+            perm=[(i, (i + 1) % n) for i in range(n)])
+        out = out + chunk
+    return out
+
+
+class ShardedGrad:
+    """A reduce-scattered gradient shard (ZeRO-sharded PS variables).
+
+    Produced by :meth:`ExecutionPlan.sync_gradients` for variables whose
+    optimizer state is sharded; consumed by ``Optimizer._apply`` (updates
+    the local shard only) or gathered to full on direct fetch.
+    """
+
+    def __init__(self, value, axis):
+        self.value = value
+        self.axis = axis
+
+    def gather(self):
+        return jax.lax.all_gather(self.value, AXIS_DATA, axis=self.axis,
+                                  tiled=True)
+
+
+class VarPlan:
+    """Resolved per-variable execution decisions."""
+
+    def __init__(self, var, node):
+        self.var = var
+        self.node = node
+        syncs = node.part_config if node.part_config else [node.synchronizer]
+        self.sync = syncs[0]
+        self.all_syncs = syncs
+        self.is_ps = isinstance(self.sync, PSSynchronizer)
+        self.is_ar = isinstance(self.sync, AllReduceSynchronizer)
+        self.num_shards = node.num_shards
+        self.partition_axis = node.partition_axis
+        self.staleness = getattr(self.sync, 'staleness', 0)
+        self.sync_mode = getattr(self.sync, 'sync', True)
+        if self.is_ar:
+            self.compressor = comp.create(self.sync.compressor, var.name)
+            self.group = self.sync.group
+            self.spec = self.sync.spec
+        else:
+            self.compressor = comp.create('NoneCompressor', var.name)
+            self.group = None
+            self.spec = 'AUTO'
+        # ZeRO-style state sharding applies to partitioned vars whose
+        # partition axis is divisible across the mesh data axis.
+        self.state_sharded = False
+        self.shard_axis = self.partition_axis if \
+            self.partition_axis is not None else 0
+
+
+class ExecutionPlan:
+    """Binds (strategy, graph_item, mesh) into callable sync/sharding hooks."""
+
+    def __init__(self, strategy, graph_item, mesh, shard_ps_state=True):
+        self.strategy = strategy
+        self.graph_item = graph_item
+        self.mesh = mesh
+        self.num_replicas = mesh.shape[AXIS_DATA]
+        self.var_plans = {}
+        nodes = {n.var_name: n for n in strategy.node_config}
+        for name, var in graph_item.trainable_var_op_to_var.items():
+            node = nodes.get(name)
+            if node is None:
+                from autodist_tpu.strategy.base import StrategyNode
+                node = StrategyNode(
+                    var_name=name, synchronizer=AllReduceSynchronizer())
+                logging.debug('Variable %s missing from strategy; '
+                              'defaulting to AllReduce', name)
+            plan = VarPlan(var, node)
+            if shard_ps_state and plan.is_ps and len(var.shape) > 0:
+                ax = plan.shard_axis
+                if var.shape[ax] % self.num_replicas == 0 and \
+                        var.shape[ax] >= self.num_replicas and \
+                        plan.num_shards > 1:
+                    plan.state_sharded = True
+            self.var_plans[name] = plan
+        self.max_staleness = max(
+            [p.staleness for p in self.var_plans.values()] + [0])
+        relaxed = [p for p in self.var_plans.values()
+                   if p.staleness > 0 or not p.sync_mode]
+        if relaxed:
+            # Within one SPMD program all replicas are lock-step, which
+            # trivially satisfies any staleness bound; the relaxed-
+            # consistency fast path (multi-process async PS over the
+            # coordination service) only engages in multi-process runs.
+            logging.warning(
+                'Strategy requests relaxed consistency (async/stale) for '
+                '%d vars; single-program execution is synchronous, which '
+                'is a valid (staleness=0) schedule of the requested bound.',
+                len(relaxed))
+
+    def plan_for(self, var):
+        name = var if isinstance(var, str) else var.name
+        return self.var_plans[name]
+
+    # -- gradient synchronization (runs inside shard_map) -----------------
+    def _reduce_fn(self, spec):
+        if spec == 'RING':
+            n = self.num_replicas
+            return lambda g: ring_all_reduce(g, AXIS_DATA) / n
+        return lambda g: jax.lax.pmean(g, AXIS_DATA)
+
+    def sync_gradients(self, sources, grads, env):
+        """Average gradients across the data axis per each var's strategy.
+
+        Same-group AllReduce vars with a stateless compressor are fused
+        into a single flat concatenated collective (scoped-allocator
+        parity); stateful compressors (EF / PowerSGD) and PS vars are
+        reduced individually.
+        """
+        if self.num_replicas == 1:
+            return grads
+        out = list(grads)
+        fusable = {}   # (group, compressor cls, dtype) -> [idx]
+        for i, (var, grad) in enumerate(zip(sources, grads)):
+            plan = self.plan_for(var)
+            if plan.state_sharded:
+                # ZeRO path: reduce-scatter straight to the shard owner.
+                g = jax.lax.psum_scatter(
+                    grad, AXIS_DATA, scatter_dimension=plan.shard_axis,
+                    tiled=True) / self.num_replicas
+                out[i] = ShardedGrad(g, plan.shard_axis)
+            elif (plan.is_ar and plan.group is not None and
+                    type(plan.compressor) in (comp.NoneCompressor,
+                                              comp.HorovodCompressor)):
+                key = (plan.group, type(plan.compressor).__name__,
+                       str(grad.dtype), plan.spec)
+                fusable.setdefault(key, []).append(i)
+            else:
+                out[i] = plan.compressor.reduce(
+                    grad, env, self._reduce_fn(plan.spec))
+        for (group, cname, dtype, spec), idxs in fusable.items():
+            if len(idxs) == 1:
+                i = idxs[0]
+                plan = self.plan_for(sources[i])
+                out[i] = plan.compressor.reduce(
+                    grads[i], env, self._reduce_fn(spec))
+                continue
+            flats = [grads[i].reshape(-1) for i in idxs]
+            sizes = [f.shape[0] for f in flats]
+            bucket = jnp.concatenate(flats)
+            if cname == 'HorovodCompressor' and \
+                    bucket.dtype == jnp.float32:
+                bucket = self._reduce_fn(spec)(
+                    bucket.astype(jnp.bfloat16)).astype(jnp.float32)
+            else:
+                bucket = self._reduce_fn(spec)(bucket)
+            offset = 0
+            for i, size in zip(idxs, sizes):
+                out[i] = bucket[offset:offset + size].reshape(
+                    grads[i].shape)
+                offset += size
+        return out
+
+    # -- state shardings (used by the Session when placing arrays) --------
+    def var_sharding(self, var_name):
+        plan = self.var_plans.get(var_name)
+        if plan is not None and plan.state_sharded:
+            spec = [None] * len(plan.var.shape)
+            spec[plan.shard_axis] = AXIS_DATA
+            return NamedSharding(self.mesh, P(*spec))
+        return NamedSharding(self.mesh, P())
+
+    def var_spec(self, var_name):
+        """PartitionSpec form (for shard_map in_specs)."""
+        plan = self.var_plans.get(var_name)
+        if plan is not None and plan.state_sharded:
+            spec = [None] * len(plan.var.shape)
+            spec[plan.shard_axis] = AXIS_DATA
+            return P(*spec)
+        return P()
+
+    def replicated_sharding(self):
+        return NamedSharding(self.mesh, P())
+
+    def feed_splittable(self, value):
+        """Reference remapper rule (remapper.py:109-123): split feeds with a
+        polymorphic batch dim across replicas, duplicate the rest."""
+        return (getattr(value, 'ndim', 0) >= 1 and
+                value.shape[0] % self.num_replicas == 0 and
+                value.shape[0] > 0)
+
+    def describe(self):
+        """Human-readable lowering summary (logged like the reference logs
+        its compiled strategy, autodist.py:117)."""
+        lines = ['ExecutionPlan over mesh %s:' % dict(self.mesh.shape)]
+        for name, p in self.var_plans.items():
+            kind = 'AllReduce' if p.is_ar else 'PS'
+            extra = ''
+            if p.num_shards > 1:
+                extra += ' shards=%d axis=%s' % (p.num_shards,
+                                                 p.partition_axis)
+            if p.state_sharded:
+                extra += ' [ZeRO-sharded]'
+            if p.is_ar:
+                extra += ' group=%s compressor=%s' % (
+                    p.group, type(p.compressor).__name__)
+            if p.staleness:
+                extra += ' staleness=%d' % p.staleness
+            lines.append('  %s: %s%s' % (name, kind, extra))
+        return '\n'.join(lines)

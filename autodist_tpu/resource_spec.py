@@ -1,0 +1,250 @@
+"""Cluster resource specification.
+
+TPU-native re-design of reference ``autodist/resource_spec.py:45-331``.
+Parses the same YAML format (nodes with address / cpus / gpus / chief /
+ssh_config / network_bandwidth, plus an ``ssh:`` config map) and extends it
+with a first-class ``tpus`` device type and ICI/DCN topology hints used by
+the mesh builder.
+
+Device strings keep the reference's ``<address>:<TYPE>:<index>`` format
+(resolver.py:47-67) so strategy protos remain human-readable.
+"""
+import os
+from enum import Enum
+
+import yaml
+
+from autodist_tpu.utils import logging
+
+DEFAULT_NETWORK_BANDWIDTH = 1  # GBE, reference resource_spec.py:210-215
+
+
+class DeviceType(Enum):
+    """Device categories; the rebuild adds TPU as a first-class type."""
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class DeviceSpec:
+    """One addressable device: ``<host>:<TYPE>:<index>``."""
+
+    def __init__(self, host_address, device_index=0,
+                 device_type=DeviceType.CPU):
+        self.host_address = host_address
+        self.device_index = int(device_index)
+        self.device_type = device_type
+
+    @property
+    def name_string(self):
+        return '%s:%s:%d' % (self.host_address, self.device_type.name,
+                             self.device_index)
+
+    def __repr__(self):
+        return '<DeviceSpec %s>' % self.name_string
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceSpec) and \
+            self.name_string == other.name_string
+
+    def __hash__(self):
+        return hash(self.name_string)
+
+    @classmethod
+    def from_string(cls, name_string):
+        """Parse ``host:TYPE:index`` back into a DeviceSpec."""
+        host, type_name, index = name_string.rsplit(':', 2)
+        return cls(host, int(index), DeviceType[type_name])
+
+
+class SSHConfig:
+    """SSH connection info for one config-map entry.
+
+    Parity with reference resource_spec.py:280-318 (username, port,
+    key_file, python_venv, shared environment variables).
+    """
+
+    def __init__(self, info):
+        self.username = info.get('username', '')
+        self.port = info.get('port', 22)
+        self.key_file = info.get('key_file')
+        self.python_venv = info.get('python_venv', '')
+        self.env = dict(info.get('shared_envs', {}))
+
+
+class SSHConfigMap(dict):
+    """Named SSH configs: ``{conf_name: SSHConfig}``."""
+
+    def __init__(self, info):
+        super().__init__({name: SSHConfig(conf)
+                          for name, conf in (info or {}).items()})
+
+
+class ResourceSpec:
+    """Parsed cluster description.
+
+    Accepts the reference YAML schema plus:
+
+    - ``tpus: [i, ...]`` per node (TPU chips on that host), or
+      ``tpus: auto`` to discover via ``jax.local_devices()`` at runtime;
+    - top-level ``mesh:`` hints (``{data: 4, model: 2, ...}``) consumed by
+      the strategy compiler when building the jax.sharding.Mesh;
+    - ``coordinator:`` address override for jax.distributed.
+    """
+
+    def __init__(self, resource_file=None, resource_info=None):
+        self.__devices = {}          # name_string -> DeviceSpec
+        self.__nodes = {}            # address -> node dict
+        self.__chief_address = None
+        self.__ssh_config_map = SSHConfigMap({})
+        self.__network_bandwidth = {}
+        self.mesh_hint = {}
+        self.coordinator_address = None
+
+        if resource_file is not None:
+            if not os.path.isfile(resource_file):
+                raise FileNotFoundError(
+                    'Resource spec file not found: %s' % resource_file)
+            with open(resource_file, 'r') as f:
+                resource_info = yaml.safe_load(f)
+        if resource_info is None:
+            raise ValueError('Must provide resource_file or resource_info')
+        self._parse(resource_info)
+
+    # -- parsing ----------------------------------------------------------
+    def _parse(self, info):
+        nodes = info.get('nodes')
+        if not nodes:
+            raise ValueError("Resource spec needs at least one node "
+                             "under 'nodes:'")
+        self.mesh_hint = dict(info.get('mesh', {}))
+        self.coordinator_address = info.get('coordinator')
+        self.__ssh_config_map = SSHConfigMap(info.get('ssh'))
+
+        for node in nodes:
+            address = str(node['address'])
+            if address in self.__nodes:
+                raise ValueError('Duplicate node address %s' % address)
+            self.__nodes[address] = node
+            if node.get('chief', False):
+                if self.__chief_address is not None:
+                    raise ValueError('Only one node may be chief')
+                self.__chief_address = address
+            host_cpu = DeviceSpec(address, 0, DeviceType.CPU)
+            self.__devices[host_cpu.name_string] = host_cpu
+            for i in node.get('cpus', []):
+                if int(i) == 0:
+                    continue
+                d = DeviceSpec(address, i, DeviceType.CPU)
+                self.__devices[d.name_string] = d
+            for i in node.get('gpus', []):
+                d = DeviceSpec(address, i, DeviceType.GPU)
+                self.__devices[d.name_string] = d
+            tpus = node.get('tpus', [])
+            if tpus == 'auto':
+                tpus = self._discover_local_tpus()
+            for i in tpus:
+                d = DeviceSpec(address, i, DeviceType.TPU)
+                self.__devices[d.name_string] = d
+            bw = node.get('network_bandwidth')
+            if bw is None:
+                logging.warning(
+                    'Network bandwidth missing for node %s; defaulting to '
+                    '%d GBE', address, DEFAULT_NETWORK_BANDWIDTH)
+                bw = DEFAULT_NETWORK_BANDWIDTH
+            self.__network_bandwidth[address] = bw
+
+        if len(self.__nodes) == 1:
+            self.__chief_address = next(iter(self.__nodes))
+        if self.__chief_address is None:
+            raise ValueError('Must specify one chief node in a '
+                             'multi-node spec')
+
+    @staticmethod
+    def _discover_local_tpus():
+        import jax
+        return [d.id for d in jax.local_devices()
+                if d.platform in ('tpu', 'axon')]
+
+    # -- accessors (parity with resource_spec.py:80-158) ------------------
+    @property
+    def chief(self):
+        """Chief node address."""
+        return self.__chief_address
+
+    @property
+    def nodes(self):
+        """Iterable of node addresses."""
+        return self.__nodes.keys()
+
+    @property
+    def devices(self):
+        """Iterable of (name_string, DeviceSpec) for all devices."""
+        return self.__devices.items()
+
+    def _filter(self, device_type):
+        return ((n, d) for n, d in self.__devices.items()
+                if d.device_type is device_type)
+
+    @property
+    def cpu_devices(self):
+        return self._filter(DeviceType.CPU)
+
+    @property
+    def gpu_devices(self):
+        return self._filter(DeviceType.GPU)
+
+    @property
+    def tpu_devices(self):
+        return self._filter(DeviceType.TPU)
+
+    @property
+    def accelerator_devices(self):
+        """GPU + TPU devices; what replicas are placed on."""
+        return ((n, d) for n, d in self.__devices.items()
+                if d.device_type is not DeviceType.CPU)
+
+    @property
+    def num_accelerators(self):
+        return sum(1 for _ in self.accelerator_devices)
+
+    def num_accelerators_on(self, address):
+        return sum(1 for _, d in self.accelerator_devices
+                   if d.host_address == address)
+
+    @property
+    def num_cpus(self):
+        return sum(1 for _ in self.cpu_devices)
+
+    @property
+    def network_bandwidth(self):
+        """Per-node bandwidth map (GBE)."""
+        return dict(self.__network_bandwidth)
+
+    @property
+    def ssh_config_map(self):
+        return self.__ssh_config_map
+
+    def ssh_config(self, address):
+        name = self.__nodes[address].get('ssh_config')
+        return self.__ssh_config_map.get(name)
+
+    @property
+    def node_cpu_devices(self):
+        """address -> [cpu name strings]."""
+        out = {}
+        for n, d in self.cpu_devices:
+            out.setdefault(d.host_address, []).append(n)
+        return out
+
+    @property
+    def node_accelerator_devices(self):
+        """address -> [accelerator name strings]."""
+        out = {}
+        for n, d in self.accelerator_devices:
+            out.setdefault(d.host_address, []).append(n)
+        return out
+
+    def __repr__(self):
+        return '<ResourceSpec chief=%s nodes=%d accelerators=%d>' % (
+            self.chief, len(self.__nodes), self.num_accelerators)

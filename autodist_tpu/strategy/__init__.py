@@ -1,0 +1,7 @@
+"""Strategy layer: representation + builders (reference autodist/strategy/)."""
+from autodist_tpu.strategy.base import (  # noqa: F401
+    AllReduceSynchronizer, GraphConfig, PSSynchronizer, Strategy,
+    StrategyBuilder, StrategyCompiler, StrategyNode, byte_size_load_fn)
+from autodist_tpu.strategy.builders import (  # noqa: F401
+    PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
+    PSLoadBalancing, RandomAxisPartitionAR, UnevenPartitionedPS)

@@ -1,0 +1,31 @@
+"""Test configuration: 8 virtual CPU devices, as the multi-device substrate.
+
+The reference emulates independent program lifecycles with forked processes
+per case (tests/integration/test_all.py:55-70); under JAX a virtual 8-device
+CPU mesh replaces that dance (SURVEY.md §4 implication note).
+
+Note: this image's sitecustomize registers a TPU ("axon") PJRT plugin in
+every interpreter and pins JAX_PLATFORMS, so plain env vars are ignored —
+``jax.config.update`` after import is the reliable override.
+"""
+import os
+
+os.environ.setdefault('AUTODIST_IS_TESTING', 'True')
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    """Each test gets a clean 'process': default-autodist slot + graph stack."""
+    yield
+    from autodist_tpu import autodist as ad_mod
+    from autodist_tpu.frontend import graph as fe
+    ad_mod._DEFAULT_AUTODIST.clear()
+    if hasattr(fe._GRAPH_STACK, 'stack'):
+        fe._GRAPH_STACK.stack.clear()

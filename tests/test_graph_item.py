@@ -1,0 +1,96 @@
+"""GraphItem capture tests (reference tests/test_graph_item.py: optimizer
+capture across many optimizer configs, scope semantics, round-trip)."""
+import numpy as np
+import pytest
+
+import autodist_tpu as ad
+from autodist_tpu.frontend import graph as fe
+from autodist_tpu.frontend import optimizers as opts
+from autodist_tpu.graph_item import GraphItem
+
+OPTIMIZER_CASES = [
+    (opts.SGD, {'learning_rate': 0.1}),
+    (opts.SGD, {'learning_rate': 0.1, 'momentum': 0.9}),
+    (opts.SGD, {'learning_rate': 0.1, 'momentum': 0.9, 'nesterov': True}),
+    (opts.Momentum, {'learning_rate': 0.1}),
+    (opts.Adam, {'learning_rate': 0.001}),
+    (opts.Adam, {'learning_rate': 0.001, 'beta_1': 0.8}),
+    (opts.AdamW, {'learning_rate': 0.001, 'weight_decay': 0.01}),
+    (opts.Adagrad, {'learning_rate': 0.01}),
+    (opts.RMSProp, {'learning_rate': 0.01}),
+    (opts.RMSProp, {'learning_rate': 0.01, 'momentum': 0.9}),
+    (opts.Adadelta, {'learning_rate': 1.0}),
+    (opts.Adamax, {'learning_rate': 0.002}),
+    (opts.LAMB, {'learning_rate': 0.001}),
+    (opts.LAMB, {'learning_rate': 0.001, 'weight_decay': 0.01}),
+]
+
+
+@pytest.mark.parametrize('opt_cls,kwargs', OPTIMIZER_CASES)
+def test_optimizer_capture(opt_cls, kwargs):
+    """Every optimizer records grad→target pairs and its ctor spec
+    (reference test_graph_item.py:55-86, 14 configs)."""
+    gi = GraphItem(graph=fe.Graph())
+    with gi.graph:
+        w = ad.Variable(np.ones((4,), np.float32), name='w')
+        x = ad.placeholder(shape=[None, 4], name='x')
+        loss = ad.ops.reduce_mean(ad.ops.square(x @ w.read()))
+        opt = opt_cls(**kwargs)
+        train_op = opt.minimize(loss)
+    gi.prepare()
+    assert len(gi.grad_target_pairs) == 1
+    (grad, target), = gi.grad_target_pairs.items()
+    assert target is w
+    assert len(gi.optimizers) == 1
+    assert isinstance(train_op, fe.ApplyGradients)
+
+
+def test_default_graph_scoping():
+    """Variables land on the graph active at creation time
+    (reference test_graph_item.py:89-100)."""
+    g1, g2 = fe.Graph(), fe.Graph()
+    with g1:
+        ad.Variable(1.0, name='a')
+        with g2:
+            ad.Variable(2.0, name='b')
+        ad.Variable(3.0, name='c')
+    assert set(g1.variables) == {'a', 'c'}
+    assert set(g2.variables) == {'b'}
+
+
+def test_duplicate_variable_name_rejected():
+    g = fe.Graph()
+    with g:
+        ad.Variable(1.0, name='v')
+        with pytest.raises(ValueError):
+            ad.Variable(2.0, name='v')
+
+
+def test_metadata_roundtrip():
+    """Serialized metadata survives a round trip
+    (reference test_graph_item.py:103-123 proto round-trip)."""
+    gi = GraphItem(graph=fe.Graph())
+    with gi.graph:
+        w = ad.Variable(np.zeros((3, 2), np.float32), name='w')
+        e = ad.Variable(np.zeros((5, 2), np.float32), name='emb')
+        idx = ad.placeholder(shape=[None], dtype=np.int32)
+        loss = ad.ops.reduce_mean(
+            ad.ops.embedding_lookup(e, idx) @ w.read().T)
+        opts.SGD(0.1).minimize(loss, [w, e])
+    gi.prepare()
+    meta = GraphItem.metadata_from_serialized(gi.serialize())
+    names = {v['name']: v for v in meta['variables']}
+    assert names['emb']['sparse_read'] is True
+    assert names['w']['sparse_read'] is False
+    assert names['w']['shape'] == [3, 2]
+    assert meta['optimizers'][0]['class'] == 'SGD'
+
+
+def test_sparse_detection():
+    gi = GraphItem(graph=fe.Graph())
+    with gi.graph:
+        e = ad.Variable(np.zeros((5, 2), np.float32), name='emb')
+        d = ad.Variable(np.zeros((5, 2), np.float32), name='dense')
+        idx = ad.placeholder(shape=[None], dtype=np.int32)
+        ad.ops.embedding_lookup(e, idx)
+    assert gi.is_sparse('emb') and not gi.is_sparse('dense')

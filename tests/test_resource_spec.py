@@ -1,0 +1,76 @@
+"""Resource spec parsing tests (reference tests/test_resource_spec.py,
+test_device_spec.py)."""
+import pytest
+
+from autodist_tpu.resource_spec import (DeviceSpec, DeviceType,
+                                        ResourceSpec)
+
+
+def make_spec(info):
+    return ResourceSpec(resource_info=info)
+
+
+def test_single_node_gpus():
+    r = make_spec({'nodes': [{'address': 'localhost', 'gpus': [0, 1]}]})
+    assert r.chief == 'localhost'
+    assert r.num_accelerators == 2
+    assert sorted(n for n, _ in r.gpu_devices) == [
+        'localhost:GPU:0', 'localhost:GPU:1']
+    # host CPU device always exists
+    assert 'localhost:CPU:0' in dict(r.cpu_devices)
+
+
+def test_tpu_device_type():
+    r = make_spec({'nodes': [
+        {'address': '10.0.0.1', 'tpus': [0, 1, 2, 3], 'chief': True,
+         'network_bandwidth': 100}]})
+    assert r.num_accelerators == 4
+    names = [n for n, _ in r.tpu_devices]
+    assert '10.0.0.1:TPU:0' in names
+
+
+def test_multi_node_chief_required():
+    with pytest.raises(ValueError):
+        make_spec({'nodes': [{'address': 'a', 'gpus': [0]},
+                             {'address': 'b', 'gpus': [0]}]})
+
+
+def test_multi_node():
+    r = make_spec({'nodes': [
+        {'address': 'a', 'gpus': [0, 1], 'chief': True},
+        {'address': 'b', 'gpus': [0, 1]}]})
+    assert r.chief == 'a'
+    assert r.num_accelerators == 4
+    assert r.num_accelerators_on('b') == 2
+    assert set(r.node_accelerator_devices) == {'a', 'b'}
+
+
+def test_ssh_config_map():
+    r = make_spec({
+        'nodes': [{'address': 'a', 'gpus': [0], 'chief': True,
+                   'ssh_config': 'conf'}],
+        'ssh': {'conf': {'username': 'u', 'key_file': '/k',
+                         'python_venv': 'source venv',
+                         'shared_envs': {'X': '1'}}}})
+    c = r.ssh_config('a')
+    assert c.username == 'u' and c.key_file == '/k'
+    assert c.env == {'X': '1'}
+
+
+def test_device_spec_roundtrip():
+    d = DeviceSpec('1.2.3.4', 3, DeviceType.TPU)
+    assert d.name_string == '1.2.3.4:TPU:3'
+    d2 = DeviceSpec.from_string(d.name_string)
+    assert d2 == d and hash(d2) == hash(d)
+
+
+def test_mesh_hint():
+    r = make_spec({'nodes': [{'address': 'h', 'tpus': [0, 1, 2, 3]}],
+                   'mesh': {'data': 2, 'model': 2}})
+    assert r.mesh_hint == {'data': 2, 'model': 2}
+
+
+def test_duplicate_node_rejected():
+    with pytest.raises(ValueError):
+        make_spec({'nodes': [{'address': 'a', 'gpus': [0]},
+                             {'address': 'a', 'gpus': [1]}]})

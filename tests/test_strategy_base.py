@@ -1,0 +1,126 @@
+"""Strategy serialize/deserialize + builder behavior tests
+(reference tests/test_strategy_base.py + builder semantics)."""
+import numpy as np
+
+import autodist_tpu as ad
+from autodist_tpu.frontend import graph as fe
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
+    PSLoadBalancing, RandomAxisPartitionAR, Strategy, UnevenPartitionedPS)
+from autodist_tpu.strategy.base import (AllReduceSynchronizer,
+                                        PSSynchronizer, StrategyCompiler)
+
+
+def capture_toy_graph():
+    """Graph with a big matrix, an embedding table (sparse), and a scalar."""
+    gi = GraphItem(graph=fe.Graph())
+    with gi.graph:
+        w = ad.Variable(np.zeros((12, 4), np.float32), name='w')
+        emb = ad.Variable(np.zeros((10, 4), np.float32), name='emb')
+        s = ad.Variable(0.5, name='s')
+        x = ad.placeholder(shape=[None], dtype=np.int32, name='x')
+        looked = ad.ops.embedding_lookup(emb, x)
+        loss = ad.ops.reduce_mean(
+            ad.ops.square(looked @ w.read().T)) + s
+        opt = ad.optimizers.SGD(0.1)
+        opt.minimize(loss, [w, emb, s])
+    gi.prepare()
+    return gi
+
+
+def two_node_spec():
+    return ResourceSpec(resource_info={'nodes': [
+        {'address': 'a', 'gpus': [0, 1], 'chief': True,
+         'network_bandwidth': 10},
+        {'address': 'b', 'gpus': [0, 1], 'network_bandwidth': 10}]})
+
+
+def test_strategy_roundtrip():
+    gi = capture_toy_graph()
+    s = AllReduce(chunk_size=2).build(gi, two_node_spec())
+    path = s.serialize()
+    s2 = Strategy.deserialize(s.id)
+    assert s2 == s
+    assert path.endswith(s.id)
+
+
+def test_all_reduce_groups():
+    gi = capture_toy_graph()
+    s = AllReduce(chunk_size=2).build(gi, two_node_spec())
+    assert len(s.node_config) == 3
+    groups = [n.synchronizer.group for n in s.node_config]
+    assert groups == [0, 0, 1]
+    assert len(s.graph_config.replicas) == 4
+
+
+def test_ps_single_destination():
+    gi = capture_toy_graph()
+    s = PS().build(gi, two_node_spec())
+    dests = {n.synchronizer.reduction_destination for n in s.node_config}
+    assert len(dests) == 1
+    assert all(isinstance(n.synchronizer, PSSynchronizer)
+               for n in s.node_config)
+
+
+def test_ps_load_balancing_spreads():
+    gi = capture_toy_graph()
+    s = PSLoadBalancing().build(gi, two_node_spec())
+    dests = [n.synchronizer.reduction_destination for n in s.node_config]
+    assert len(set(dests)) == 2  # two CPU devices available
+
+
+def test_partitioned_ps_shards():
+    gi = capture_toy_graph()
+    s = PartitionedPS().build(gi, two_node_spec())
+    w_node = next(n for n in s.node_config if n.var_name == 'w')
+    # w has dim0=12 -> smallest nontrivial divisor 2
+    assert w_node.partitioner == '2,1'
+    assert w_node.num_shards == 2 and w_node.partition_axis == 0
+    assert len(w_node.part_config) == 2
+    s_node = next(n for n in s.node_config if n.var_name == 's')
+    assert s_node.partitioner == '' and s_node.synchronizer is not None
+
+
+def test_uneven_partitioned_ps():
+    gi = capture_toy_graph()
+    s = UnevenPartitionedPS().build(gi, two_node_spec())
+    w_node = next(n for n in s.node_config if n.var_name == 'w')
+    # smallest non-divisor of 12 is 5
+    assert w_node.partitioner == '5,1'
+
+
+def test_partitioned_ar():
+    gi = capture_toy_graph()
+    s = PartitionedAR().build(gi, two_node_spec())
+    w_node = next(n for n in s.node_config if n.var_name == 'w')
+    assert w_node.num_shards == 2
+    assert all(isinstance(p, AllReduceSynchronizer)
+               for p in w_node.part_config)
+
+
+def test_random_axis_partition_ar_sparse_axis0():
+    gi = capture_toy_graph()
+    s = RandomAxisPartitionAR(seed=0).build(gi, two_node_spec())
+    emb_node = next(n for n in s.node_config if n.var_name == 'emb')
+    assert emb_node.partition_axis == 0  # sparse forced to axis 0
+
+
+def test_parallax_hybrid():
+    gi = capture_toy_graph()
+    s = Parallax().build(gi, two_node_spec())
+    by_name = {n.var_name: n for n in s.node_config}
+    assert isinstance(by_name['emb'].synchronizer, PSSynchronizer)
+    assert isinstance(by_name['w'].synchronizer, AllReduceSynchronizer)
+    assert isinstance(by_name['s'].synchronizer, AllReduceSynchronizer)
+
+
+def test_compiler_prunes_unknown_vars():
+    gi = capture_toy_graph()
+    s = AllReduce().build(gi, two_node_spec())
+    from autodist_tpu.strategy.base import StrategyNode
+    s.node_config.append(StrategyNode(
+        var_name='ghost', synchronizer=AllReduceSynchronizer()))
+    compiled = StrategyCompiler(gi).compile(s)
+    assert all(n.var_name != 'ghost' for n in compiled.node_config)
