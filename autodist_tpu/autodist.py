@@ -86,7 +86,6 @@ class AutoDist:
         self._built = False
         # ad.function state
         self._fn_cache = {}
-        self._ph_feed_index = {}
 
     # -- capture -----------------------------------------------------------
     def scope(self):

@@ -367,10 +367,23 @@ def evaluate(node, env):
 
 def _resolve(x, env):
     if isinstance(x, (SymTensor, Variable)):
-        return evaluate(x, env)
+        return _degrade(evaluate(x, env))
     if isinstance(x, (list, tuple)):
         return type(x)(_resolve(v, env) for v in x)
     return x
+
+
+def _degrade(val):
+    """Materialize framework wrappers before generic jnp ops consume them.
+
+    A ZeRO-sharded gradient (parallel.plan.ShardedGrad) stays a shard on
+    the ApplyGradients fast path, but user arithmetic on it (grad-norm
+    clipping etc.) needs the full array — gather without disturbing the
+    memoized shard."""
+    if isinstance(val, list):
+        return [_degrade(v) for v in val]
+    gather = getattr(val, 'gather', None)
+    return gather() if callable(gather) else val
 
 
 def _eval(node, env):

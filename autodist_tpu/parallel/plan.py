@@ -222,9 +222,16 @@ class ExecutionPlan:
     def replicated_sharding(self):
         return NamedSharding(self.mesh, P())
 
-    def feed_splittable(self, value):
+    def feed_splittable(self, value, placeholder=None):
         """Reference remapper rule (remapper.py:109-123): split feeds with a
-        polymorphic batch dim across replicas, duplicate the rest."""
+        *polymorphic* (declared-None) batch dim across replicas, duplicate
+        the rest. Fixed-shape placeholders are never split, matching the
+        reference's shape-compatibility check."""
+        if placeholder is not None:
+            shape = getattr(placeholder, 'shape', None)
+            if shape is not None and (len(shape) == 0 or
+                                      shape[0] is not None):
+                return False
         return (getattr(value, 'ndim', 0) >= 1 and
                 value.shape[0] % self.num_replicas == 0 and
                 value.shape[0] > 0)

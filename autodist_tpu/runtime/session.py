@@ -143,7 +143,7 @@ class Session:
             if v.dtype == np.float64:
                 v = v.astype(np.float32)
             feed_vals.append(v)
-            split_flags.append(self._plan.feed_splittable(v))
+            split_flags.append(self._plan.feed_splittable(v, ph))
 
         key = (tuple(id(f) for f in norm),
                tuple((id(p), v.shape, str(v.dtype), s)
@@ -186,6 +186,8 @@ class Session:
         """Apply the reference fetch contract to the per-replica stack."""
         if isinstance(fetch, fe.ApplyGradients):
             return None
+        if isinstance(stacked, list):  # list-valued fetch (Gradients)
+            return [np.asarray(s)[0] for s in stacked]
         val = np.asarray(stacked)
         n = self._plan.num_replicas
         local = val[0]
@@ -241,12 +243,18 @@ class Session:
                          opt_state=opt_state, aux_state=aux_local)
             env.var_shards = shards
             env.plan = plan
+            def box(v):
+                if isinstance(v, ShardedGrad):
+                    v = v.gather()
+                return jnp.asarray(v)[None]  # stack dim for P(data)
+
             outs = []
             for node in fetch_nodes:
                 val = fe.evaluate(node, env)
-                if isinstance(val, ShardedGrad):
-                    val = val.gather()
-                outs.append(jnp.asarray(val)[None])  # stack dim for P(data)
+                # list-valued fetches (a Gradients node) stay a list —
+                # out_specs broadcast over the subtree as a pytree prefix
+                outs.append([box(v) for v in val]
+                            if isinstance(val, (list, tuple)) else box(val))
             new_vars = dict(var_state)
             for name, val in env.updates.items():
                 new_vars[name] = val
