@@ -1,0 +1,283 @@
+"""Functional training API: the big-model path.
+
+The reference's session path captures an unmodified TF-graph program and
+rewrites it (SURVEY.md §3.2). For models written against the functional
+module system (:mod:`autodist_tpu.models`), the TPU-native path skips
+capture entirely: the user hands a model + optimizer + :class:`ParallelSpec`
+to :class:`Trainer`, which
+
+1. builds the device mesh (data/pipe/seq/expert/model axes),
+2. binds every param to a ``NamedSharding`` from its logical axes
+   (ZeRO stages extend the binding over the data axis),
+3. compiles ONE fused XLA train step — forward, backward, collectives,
+   optimizer — via ``jit`` with explicit in/out shardings and donated
+   state (GSPMD inserts the DP/TP/EP collectives; sequence parallelism
+   runs the model inside a partial-manual ``shard_map`` for ring
+   attention),
+4. exposes reference-shaped ergonomics: ``init`` / ``step`` / fetch.
+
+This is the lowering target the strategy builders compile to for
+functional models (strategy → ParallelSpec adapter in
+:mod:`autodist_tpu.strategy.adapter`).
+"""
+import functools
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import AXIS_DATA, AXIS_PIPELINE, AXIS_SEQUENCE
+from autodist_tpu.parallel.axes import (ParallelSpec, sharding_ctx,
+                                        shardings_for_tree, spec_for_axes)
+from autodist_tpu.utils import logging
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any
+
+    @classmethod
+    def create(cls, params, opt_state):
+        return cls(params=params, opt_state=opt_state,
+                   step=jnp.zeros((), jnp.int32))
+
+
+class Trainer:
+    """Compile + drive distributed training of a functional model.
+
+    Args:
+        model: a :class:`autodist_tpu.models.core.Module` with
+            ``init``/``apply``/``axes`` (and ``loss`` unless ``loss_fn``
+            is given).
+        optimizer: an optax ``GradientTransformation``.
+        spec: :class:`ParallelSpec`; defaults to pure DP over all devices.
+        loss_fn: ``loss_fn(params, batch) -> scalar``; defaults to
+            ``model.loss``. In sequence-parallel mode the model must
+            provide ``per_token_loss`` instead.
+        mesh: optional prebuilt mesh (else ``spec.build_mesh()``).
+    """
+
+    def __init__(self, model, optimizer, spec=None, loss_fn=None,
+                 mesh=None, rules=None, donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.spec = spec or ParallelSpec()
+        self.mesh = mesh if mesh is not None else self.spec.build_mesh()
+        self.rules = rules if rules is not None else self.spec.rules
+        self._loss_fn = loss_fn
+        self._donate = donate
+        self._axes_tree = model.axes()
+        self.param_shardings = shardings_for_tree(
+            self._axes_tree, self.rules, self.mesh)
+        self._step_cache = {}
+        logging.info('Trainer mesh: %s, zero=%d, sp=%d',
+                     dict(self.mesh.shape), self.spec.zero, self.spec.sp)
+
+    # -- sharding helpers --------------------------------------------------
+    def _zero_extend(self, sharding, shape):
+        """Extend a sharding over the data axis on the first free
+        divisible dim (ZeRO/FSDP-style). Used for optimizer slots
+        (zero>=2) and params (zero==3)."""
+        spec = list(sharding.spec) + [None] * (len(shape) -
+                                               len(sharding.spec))
+        dp = self.mesh.shape[AXIS_DATA]
+        if dp <= 1:
+            return sharding
+        used = {a for a in spec if a is not None}
+        if AXIS_DATA in used:
+            return sharding
+        for i, dim in enumerate(shape):
+            if spec[i] is None and dim % dp == 0 and dim >= dp:
+                spec[i] = AXIS_DATA
+                return NamedSharding(self.mesh, P(*spec))
+        return sharding
+
+    def _param_sharding_tree(self, params):
+        shardings = self.param_shardings
+        if self.spec.zero >= 3:
+            shardings = jax.tree.map(
+                lambda s, p: self._zero_extend(s, p.shape),
+                shardings, params)
+        return shardings
+
+    def _opt_sharding(self, opt_state, params, param_shardings):
+        """Slot leaves with a param's shape shard like (or beyond) it."""
+        flat_params = jax.tree.leaves(params)
+        flat_shards = jax.tree.leaves(
+            param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+        by_shape = {}
+        for p, s in zip(flat_params, flat_shards):
+            by_shape.setdefault(tuple(p.shape), s)
+
+        def place(leaf):
+            sh = by_shape.get(tuple(getattr(leaf, 'shape', ())))
+            if sh is None:
+                return NamedSharding(self.mesh, P())
+            if self.spec.zero >= 2:
+                return self._zero_extend(sh, leaf.shape)
+            return sh
+
+        return jax.tree.map(place, opt_state)
+
+    def batch_sharding(self, batch):
+        """Leading dim over data; dim 1 over seq for rank>=2 leaves when
+        sequence parallelism is on."""
+        def leaf_sharding(x):
+            nd = getattr(x, 'ndim', 0)
+            if nd == 0:
+                return NamedSharding(self.mesh, P())
+            if nd >= 2 and self.spec.sp > 1:
+                return NamedSharding(self.mesh, P(AXIS_DATA, AXIS_SEQUENCE))
+            return NamedSharding(self.mesh, P(AXIS_DATA))
+        return jax.tree.map(leaf_sharding, batch)
+
+    def shard_batch(self, batch):
+        """Host batch -> sharded device arrays (remapper feed equivalent)."""
+        return jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s),
+            batch, self.batch_sharding(batch))
+
+    # -- init --------------------------------------------------------------
+    def init(self, rng, params=None):
+        """Materialize sharded TrainState (params + optimizer slots)."""
+        if params is None:
+            with sharding_ctx(self.mesh, self.rules):
+                shapes = jax.eval_shape(self.model.init, rng)
+                shardings = self._param_sharding_tree(shapes)
+                init_fn = jax.jit(self.model.init,
+                                  out_shardings=shardings)
+                params = init_fn(rng)
+        else:
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(jnp.asarray(x), s),
+                params, self._param_sharding_tree(params))
+        opt_state = jax.jit(self.optimizer.init)(params)
+        opt_shardings = self._opt_sharding(opt_state, params,
+                                           self._param_sharding_tree(params))
+        opt_state = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), opt_state, opt_shardings)
+        return TrainState.create(params, opt_state)
+
+    # -- the compiled step -------------------------------------------------
+    @property
+    def manual_axes(self):
+        """Mesh axes the step runs manually (inside shard_map): pipeline
+        (GPipe ppermute schedule) and sequence (ring attention)."""
+        axes = []
+        if self.spec.pp > 1:
+            axes.append(AXIS_PIPELINE)
+        if self.spec.sp > 1:
+            axes.append(AXIS_SEQUENCE)
+        return tuple(axes)
+
+    def loss_for(self, params, batch):
+        if self.manual_axes:
+            return self._manual_loss(params, batch)
+        if self._loss_fn is not None:
+            return self._loss_fn(params, batch)
+        return self.model.loss(params, batch)
+
+    def _manual_spec(self, axes):
+        """A param's in_spec for the manual region: its full spec with
+        non-manual (still-automatic) mesh axes stripped."""
+        full = spec_for_axes(axes, self.rules, self.mesh)
+        manual = self.manual_axes
+        kept = [a if a in manual else None for a in full]
+        while kept and kept[-1] is None:
+            kept.pop()
+        return P(*kept)
+
+    def _manual_loss(self, params, batch):
+        """Sequence/pipeline-parallel loss: the model runs inside a
+        partial-manual shard_map (ring attention over ``seq``, GPipe over
+        ``pipe``); per-token losses reduce outside."""
+        model = self.model
+        rules = self.rules
+        mesh = self.mesh
+        manual = self.manual_axes
+        options = {'microbatches': self.spec.microbatches}
+
+        def per_token(params, batch):
+            with sharding_ctx(mesh, rules, manual_axes=manual,
+                              options=options):
+                if hasattr(model, 'per_token_loss_with_aux'):
+                    nll, aux = model.per_token_loss_with_aux(params, batch)
+                else:
+                    nll = model.per_token_loss(params, batch)
+                    aux = jnp.zeros((), jnp.float32)
+                # aux (e.g. MoE balance) is computed per manual shard;
+                # average to one well-defined replicated value
+                for ax in manual:
+                    aux = jax.lax.pmean(aux, ax)
+                return nll, aux
+
+        param_specs = jax.tree.map(
+            self._manual_spec, self._axes_tree,
+            is_leaf=lambda x: x is None or (
+                isinstance(x, tuple) and
+                all(isinstance(a, (str, type(None))) for a in x)))
+        sp_on = AXIS_SEQUENCE in manual
+        batch_spec = P(None, AXIS_SEQUENCE) if sp_on else P()
+        mapped = jax.shard_map(
+            per_token, mesh=self.mesh,
+            in_specs=(param_specs, batch_spec),
+            out_specs=(P(None, AXIS_SEQUENCE) if sp_on else P(), P()),
+            axis_names=set(manual), check_vma=False)
+        nll, aux = mapped(params, batch)
+        mask = batch.get('mask') if hasattr(batch, 'get') else None
+        if mask is not None:
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        else:
+            ce = jnp.mean(nll)
+        return ce + getattr(self.model, 'aux_loss_weight', 0.0) * aux
+
+    def _build_step(self, batch_struct):
+        def step_fn(state, batch):
+            def loss_fn(p):
+                with sharding_ctx(self.mesh, self.rules):
+                    return self.loss_for(p, batch)
+            if self.spec.remat == 'full':
+                loss_fn = jax.checkpoint(loss_fn)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = jax.tree.map(
+                lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+            return TrainState(params=new_params, opt_state=new_opt,
+                              step=state.step + 1), {'loss': loss}
+
+        return step_fn
+
+    def step(self, state, batch):
+        """One optimizer step; returns (new_state, metrics)."""
+        struct = jax.tree.structure(batch)
+        shapes = tuple((tuple(np.shape(x)), np.asarray(x).dtype.str
+                        if not hasattr(x, 'dtype') else str(x.dtype))
+                       for x in jax.tree.leaves(batch))
+        key = (struct, shapes)
+        if key not in self._step_cache:
+            step_fn = self._build_step(struct)
+            param_sh = self._param_sharding_tree(state.params)
+            opt_sh = self._opt_sharding(state.opt_state, state.params,
+                                        param_sh)
+            state_sh = TrainState(params=param_sh, opt_state=opt_sh,
+                                  step=NamedSharding(self.mesh, P()))
+            self._step_cache[key] = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, self.batch_sharding(batch)),
+                out_shardings=(state_sh, None),
+                donate_argnums=(0,) if self._donate else ())
+        batch = self.shard_batch(batch)
+        return self._step_cache[key](state, batch)
+
+    # -- fetch helpers (reference get-variable parity) ---------------------
+    def get_params(self, state):
+        """Gather params to host in logical (unsharded) layout."""
+        return jax.tree.map(np.asarray, jax.device_get(state.params))

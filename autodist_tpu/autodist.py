@@ -119,9 +119,30 @@ class AutoDist:
         logging.info('Compiled strategy: %s', compiled)
         return compiled
 
+    def _setup(self, strategy):
+        """Chief-side cluster bring-up + worker launch (reference
+        autodist.py:120-128).
+
+        Order matters: workers must be launched BEFORE the blocking
+        ``jax.distributed.initialize`` in ``cluster.start()`` — the
+        runtime only forms once the full quorum dials in. The chief also
+        claims its own identity (process 0 of len(nodes)) so start()
+        actually initializes multi-process mode."""
+        nodes = list(self._resource_spec.nodes)
+        if IS_AUTODIST_CHIEF and len(nodes) > 1:
+            os.environ.setdefault(ENV.AUTODIST_NUM_PROCESSES.name,
+                                  str(len(nodes)))
+            os.environ.setdefault(ENV.AUTODIST_PROCESS_ID.name, '0')
+            from autodist_tpu.runtime.coordinator import Coordinator
+            self._coordinator = Coordinator(
+                strategy, self._resource_spec, self._cluster)
+            self._coordinator.launch_clients()
+            atexit.register(self._coordinator.terminate)
+        self._cluster.start()
+
     def _build(self):
         strategy = self._build_or_load_strategy()
-        self._cluster.start()
+        self._setup(strategy)
         compiled = self._compile_strategy(strategy)
         mesh = mesh_from_strategy(compiled, self._resource_spec)
         plan = ExecutionPlan(compiled, self._original_graph_item, mesh)

@@ -1,0 +1,198 @@
+"""Checkpointing in logical (unsharded) layout.
+
+Reference semantics (autodist/checkpoint/saver.py:50-57, proven by
+restoring into vanilla TF in cases/c0.py:124-132): checkpoints written
+under ANY distribution strategy have the original single-device variable
+layout, so they are interchangeable between strategies and with
+non-distributed runs. The TPU rebuild keeps that contract: sharded
+``jax.Array``s are gathered per-leaf to host logical layout and written
+to a self-contained directory (``manifest.json`` + one ``.npy`` per
+tensor). Restore works into any Trainer/Session regardless of mesh —
+arrays are re-placed according to the live sharding.
+
+Format notes: .npy per leaf (not one .npz) keeps writes streamable and
+lets a future native writer parallelize per-tensor IO; orbax can read
+the same trees via ``to_pytree``/``from_pytree`` if users prefer its
+async machinery.
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+
+import jax
+
+from autodist_tpu.utils import logging
+
+
+def _leaf_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                        for k in path)
+        out.append((name, leaf))
+    return out, treedef
+
+
+def save_pytree(path, tree, step=None, overwrite=True):
+    """Write a pytree of arrays to ``path`` in logical layout."""
+    tmp = path + '.tmp'
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    flat, _ = _leaf_paths(tree)
+    manifest = {'format': 'autodist_tpu.ckpt.v1', 'step': step,
+                'tensors': {}}
+    for name, leaf in flat:
+        host = np.asarray(jax.device_get(leaf))
+        fname = name.replace('/', '.') + '.npy'
+        np.save(os.path.join(tmp, fname), host)
+        manifest['tensors'][name] = {
+            'file': fname, 'shape': list(host.shape),
+            'dtype': str(host.dtype)}
+    with open(os.path.join(tmp, 'manifest.json'), 'w') as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+    logging.info('Saved checkpoint (%d tensors) to %s',
+                 len(manifest['tensors']), path)
+    return path
+
+
+def load_pytree(path, like=None):
+    """Load a checkpoint directory.
+
+    With ``like`` (a structural template pytree), returns the same
+    structure; leaves are host arrays. Without it, returns a flat
+    {name: array} dict.
+    """
+    with open(os.path.join(path, 'manifest.json')) as f:
+        manifest = json.load(f)
+    tensors = {name: np.load(os.path.join(path, meta['file']))
+               for name, meta in manifest['tensors'].items()}
+    if like is None:
+        return tensors, manifest.get('step')
+    flat, treedef = _leaf_paths(like)
+    leaves = []
+    for name, leaf in flat:
+        if name not in tensors:
+            raise KeyError('Checkpoint %s missing tensor %r' %
+                           (path, name))
+        want = tuple(getattr(leaf, 'shape', ()))
+        got = tensors[name].shape
+        if want and tuple(got) != want:
+            raise ValueError('Shape mismatch for %r: ckpt %s vs model %s'
+                             % (name, got, want))
+        leaves.append(tensors[name])
+    return jax.tree_util.tree_unflatten(treedef, leaves), \
+        manifest.get('step')
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (keep latest k)."""
+
+    def __init__(self, directory, max_to_keep=3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _ckpt_path(self, step):
+        return os.path.join(self.directory, 'ckpt-%d' % step)
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith('ckpt-') and not name.endswith('.tmp'):
+                try:
+                    steps.append(int(name.split('-', 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step, tree):
+        path = save_pytree(self._ckpt_path(step), tree, step=step)
+        for old in self.all_steps()[:-self.max_to_keep]:
+            shutil.rmtree(self._ckpt_path(old))
+        return path
+
+    def restore(self, like=None, step=None):
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None, None
+        tree, _ = load_pytree(self._ckpt_path(step), like=like)
+        return tree, step
+
+
+# -- reference-parity Saver over the DSL Session --------------------------
+
+class Saver:
+    """tf.train.Saver-shaped facade for the DSL/session path.
+
+    Reference contract (checkpoint/saver.py:85-133): construct before the
+    distributed session; save/restore run against the session's variables
+    and produce single-node-layout checkpoints.
+    """
+
+    def __init__(self, var_list=None, max_to_keep=5):
+        from autodist_tpu.frontend import graph as fe
+        self._graph = fe.get_default_graph()
+        self._vars = ({v.name: v for v in var_list} if var_list
+                      else dict(self._graph.variables))
+        self._max_to_keep = max_to_keep
+        self._graph.savers.append(self)
+
+    def save(self, sess, save_path, global_step=None):
+        tree = {name: sess.get_variable_value(name)
+                for name in self._vars}
+        path = save_path if global_step is None \
+            else '%s-%d' % (save_path, global_step)
+        return save_pytree(path, tree, step=global_step)
+
+    def restore(self, sess, save_path):
+        tensors, _ = load_pytree(save_path)
+        for name in self._vars:
+            if name not in tensors:
+                raise KeyError('Checkpoint missing variable %r' % name)
+            sess.load_variable_value(name, tensors[name])
+        logging.info('Restored %d variables from %s',
+                     len(self._vars), save_path)
+
+
+class SavedModelBuilder:
+    """Export a servable bundle (reference saved_model_builder.py:24-64):
+    model params in logical layout + a JSON signature description."""
+
+    def __init__(self, export_dir):
+        self.export_dir = export_dir
+        self._saved = False
+
+    def add_meta_graph_and_variables(self, sess, tags,
+                                     signature_def_map=None):
+        self._sess = sess
+        self._tags = list(tags)
+        self._signatures = signature_def_map or {}
+        return self
+
+    def save(self):
+        if self._saved:
+            raise RuntimeError('SavedModelBuilder.save called twice')
+        tree = {name: self._sess.get_variable_value(name)
+                for name in self._sess._graph_item.graph.variables}
+        save_pytree(os.path.join(self.export_dir, 'variables'), tree)
+        meta = {'tags': self._tags,
+                'signatures': {k: str(v)
+                               for k, v in self._signatures.items()}}
+        with open(os.path.join(self.export_dir, 'saved_model.json'),
+                  'w') as f:
+            json.dump(meta, f, indent=1)
+        self._saved = True
+        return self.export_dir

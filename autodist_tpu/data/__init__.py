@@ -1,0 +1,3 @@
+"""Input pipeline: native prefetching record loader + host sharding."""
+from autodist_tpu.data.loader import (DataLoader, read_record_header,  # noqa: F401
+                                      write_records)

@@ -1,0 +1,10 @@
+"""``python -m autodist_tpu.launch`` — multi-host process launcher.
+
+See :func:`autodist_tpu.runtime.coordinator.launch_cli`.
+"""
+import sys
+
+from autodist_tpu.runtime.coordinator import launch_cli
+
+if __name__ == '__main__':
+    sys.exit(launch_cli())

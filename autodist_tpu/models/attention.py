@@ -1,0 +1,55 @@
+"""Multi-head attention with tensor- and sequence-parallel execution.
+
+Heads shard over the ``model`` mesh axis (Megatron column/row split via
+the logical ``heads`` axis); the sequence dimension shards over ``seq``
+when the step runs in explicit (shard_map) mode, in which case the module
+switches to ring attention (parallel/ring_attention.py). The reference
+has neither TP nor SP (SURVEY.md §2.3) — these are the TPU-native
+extension axes of the strategy space.
+"""
+import jax.numpy as jnp
+
+from autodist_tpu.const import AXIS_SEQUENCE
+from autodist_tpu.models.core import Dense, Module, constrain
+from autodist_tpu.parallel.axes import manual_axis
+from autodist_tpu.parallel.ring_attention import (local_flash_attention,
+                                                  ring_attention)
+
+
+class MultiHeadAttention(Module):
+    """Causal (or full) self-attention; [batch, seq, embed] in/out."""
+
+    def __init__(self, dim, num_heads, head_dim=None, causal=True,
+                 dtype=jnp.float32):
+        self.dim = dim
+        self.num_heads = num_heads
+        self.head_dim = head_dim or dim // num_heads
+        self.causal = causal
+        self.dtype = dtype
+        inner = self.num_heads * self.head_dim
+        # qkv fused: column-parallel over heads; out: row-parallel back.
+        self.wqkv = Dense(dim, 3 * inner, 'embed', 'heads',
+                          use_bias=False, dtype=dtype)
+        self.wo = Dense(inner, dim, 'heads', 'embed',
+                        use_bias=False, dtype=dtype)
+
+    def param_defs(self):
+        return {'qkv': self.wqkv, 'out': self.wo}
+
+    def apply(self, params, x):
+        b, s, _ = x.shape
+        h, d = self.num_heads, self.head_dim
+        qkv = self.wqkv.apply(params['qkv'], x)          # [b, s, 3hd]
+        qkv = qkv.reshape(b, s, 3, h, d)
+        q = jnp.transpose(qkv[:, :, 0], (0, 2, 1, 3))     # [b, h, s, d]
+        k = jnp.transpose(qkv[:, :, 1], (0, 2, 1, 3))
+        v = jnp.transpose(qkv[:, :, 2], (0, 2, 1, 3))
+
+        seq_axis = manual_axis(AXIS_SEQUENCE)
+        if seq_axis is not None:
+            o = ring_attention(q, k, v, seq_axis, causal=self.causal)
+        else:
+            o = local_flash_attention(q, k, v, causal=self.causal)
+            o = constrain(o, ('batch', 'heads', 'seq', 'kv'))
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, h * d)
+        return self.wo.apply(params['out'], o)

@@ -1,0 +1,176 @@
+"""Minimal functional module system for the model zoo.
+
+Models are pytrees of plain ``jax.Array`` params plus a parallel metadata
+tree of *logical axis names* consumed by the sharding compiler
+(:mod:`autodist_tpu.parallel.axes`). No framework magic: ``init`` builds
+the param dict, ``apply`` is a pure function, so every model composes with
+``jit`` / ``shard_map`` / ``jax.grad`` directly. This replaces the
+reference's reliance on captured TF graphs + Keras (SURVEY.md §7: the
+capture shim is only needed for API parity, not for the compute path).
+
+Conventions:
+- ``param_defs()`` -> {name: ParamDef | Module} describes one module level.
+- params are nested dicts mirroring that structure.
+- ``axes()`` returns the same nesting with ``ParamDef.axes`` at leaves.
+- compute dtype is configurable (bfloat16 by default on TPU-class runs);
+  params stay float32 (master weights), cast at use.
+"""
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.parallel.axes import constrain, live_mesh_axis
+
+
+@dataclass
+class ParamDef:
+    shape: tuple
+    axes: tuple            # logical axis names, len == len(shape)
+    init: str = 'normal'   # normal | zeros | ones | fan_in
+    scale: float = 0.02
+
+
+class Module:
+    """Base: generic init/axes tree walks over ``param_defs()``."""
+
+    def param_defs(self):
+        raise NotImplementedError
+
+    def apply(self, params, *args, **kwargs):
+        raise NotImplementedError
+
+    def init(self, rng):
+        defs = self.param_defs()
+        keys = jax.random.split(rng, max(len(defs), 1))
+        out = {}
+        for k, (name, d) in zip(keys, sorted(defs.items())):
+            out[name] = d.init(k) if isinstance(d, Module) \
+                else _init_leaf(k, d)
+        return out
+
+    def axes(self):
+        return {name: (d.axes() if isinstance(d, Module) else d.axes)
+                for name, d in sorted(self.param_defs().items())}
+
+    def __call__(self, params, *args, **kwargs):
+        return self.apply(params, *args, **kwargs)
+
+
+def _init_leaf(rng, d):
+    if d.init == 'zeros':
+        return jnp.zeros(d.shape, jnp.float32)
+    if d.init == 'ones':
+        return jnp.ones(d.shape, jnp.float32)
+    if d.init == 'fan_in':
+        fan_in = d.shape[0] if len(d.shape) > 1 else max(d.shape[0], 1)
+        std = 1.0 / math.sqrt(fan_in)
+        return jax.random.normal(rng, d.shape, jnp.float32) * std
+    return jax.random.normal(rng, d.shape, jnp.float32) * d.scale
+
+
+class Sequential(Module):
+    """Compose modules; params keyed layer_0, layer_1, ..."""
+
+    def __init__(self, layers):
+        self.layers = list(layers)
+
+    def param_defs(self):
+        return {'layer_%03d' % i: m for i, m in enumerate(self.layers)}
+
+    def apply(self, params, x, **kw):
+        for i, m in enumerate(self.layers):
+            x = m.apply(params['layer_%03d' % i], x, **kw)
+        return x
+
+
+class Dense(Module):
+    """y = x @ w + b with logical axes for the two matmul dims."""
+
+    def __init__(self, in_dim, out_dim, in_axis='embed', out_axis='mlp',
+                 use_bias=True, dtype=jnp.float32, name=None):
+        self.in_dim, self.out_dim = in_dim, out_dim
+        self.in_axis, self.out_axis = in_axis, out_axis
+        self.use_bias = use_bias
+        self.dtype = dtype
+
+    def param_defs(self):
+        d = {'kernel': ParamDef((self.in_dim, self.out_dim),
+                                (self.in_axis, self.out_axis), 'fan_in')}
+        if self.use_bias:
+            d['bias'] = ParamDef((self.out_dim,), (self.out_axis,), 'zeros')
+        return d
+
+    def apply(self, params, x):
+        w = params['kernel'].astype(self.dtype)
+        y = x.astype(self.dtype) @ w
+        if self.use_bias:
+            y = y + params['bias'].astype(self.dtype)
+        return y
+
+
+class Embedding(Module):
+    """Token embedding; vocab dim shardable (EP-lite of the reference's
+    partitioned embeddings, partitioner.py:576-602)."""
+
+    def __init__(self, vocab, dim, vocab_axis='vocab', dim_axis='embed',
+                 dtype=jnp.float32):
+        self.vocab, self.dim = vocab, dim
+        self.vocab_axis, self.dim_axis = vocab_axis, dim_axis
+        self.dtype = dtype
+
+    def param_defs(self):
+        return {'table': ParamDef((self.vocab, self.dim),
+                                  (self.vocab_axis, self.dim_axis),
+                                  'normal', 0.02)}
+
+    def apply(self, params, ids):
+        table = params['table'].astype(self.dtype)
+        if live_mesh_axis(self.vocab_axis) is not None:
+            # Tensor-sharded table: one-hot matmul instead of gather —
+            # partitions cleanly (each shard contributes its slice via a
+            # plain dot) and runs on the MXU.
+            oh = jax.nn.one_hot(ids, self.vocab, dtype=self.dtype)
+            return oh @ table
+        return jnp.take(table, ids, axis=0)
+
+    def attend(self, params, x):
+        """Tied-output logits: x @ table.T"""
+        return x @ params['table'].astype(self.dtype).T
+
+
+class LayerNorm(Module):
+    def __init__(self, dim, axis_name='embed', eps=1e-6,
+                 dtype=jnp.float32):
+        self.dim, self.axis_name, self.eps = dim, axis_name, eps
+        self.dtype = dtype
+
+    def param_defs(self):
+        return {'scale': ParamDef((self.dim,), (self.axis_name,), 'ones'),
+                'bias': ParamDef((self.dim,), (self.axis_name,), 'zeros')}
+
+    def apply(self, params, x):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + self.eps)
+        y = y * params['scale'] + params['bias']
+        return y.astype(self.dtype)
+
+
+class Mlp(Module):
+    """Transformer MLP: Megatron column- then row-parallel pair."""
+
+    def __init__(self, dim, hidden, dtype=jnp.float32, act=jax.nn.gelu):
+        self.up = Dense(dim, hidden, 'embed', 'mlp', dtype=dtype)
+        self.down = Dense(hidden, dim, 'mlp', 'embed', dtype=dtype)
+        self.act = act
+
+    def param_defs(self):
+        return {'up': self.up, 'down': self.down}
+
+    def apply(self, params, x):
+        h = self.act(self.up.apply(params['up'], x))
+        h = constrain(h, ('batch', 'seq', 'mlp'))
+        return self.down.apply(params['down'], h)

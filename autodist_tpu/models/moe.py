@@ -1,0 +1,88 @@
+"""Mixture-of-experts MLP with expert parallelism.
+
+The reference's closest feature is sparse-variable partitioning
+("EP-lite", SURVEY.md §2.3); real expert parallelism is a TPU-native
+extension axis. Design is the Switch/GShard dense-dispatch formulation:
+top-k routing builds a dispatch tensor contracted with einsums, so expert
+compute stays static-shaped (MXU/XLA-friendly, no ragged scatter) and
+sharding the expert dim over the ``expert`` mesh axis makes GSPMD insert
+the all-to-alls. Overflowed tokens beyond per-expert capacity are dropped
+(standard Switch behavior); an auxiliary load-balancing loss is returned
+via a side channel.
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models.core import Dense, Module, ParamDef, constrain
+
+
+class MoeMlp(Module):
+    """Top-k routed expert MLP. Input/output: [batch, seq, dim]."""
+
+    def __init__(self, dim, hidden, n_experts, top_k=2,
+                 capacity_factor=2.0, dtype=jnp.float32,
+                 act=jax.nn.gelu):
+        self.dim, self.hidden = dim, hidden
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.dtype = dtype
+        self.act = act
+        self.router = Dense(dim, n_experts, 'embed', None,
+                            use_bias=False, dtype=jnp.float32)
+
+    def param_defs(self):
+        return {
+            'router': self.router,
+            'up': ParamDef((self.n_experts, self.dim, self.hidden),
+                           ('expert', 'embed', 'mlp'), 'fan_in'),
+            'down': ParamDef((self.n_experts, self.hidden, self.dim),
+                             ('expert', 'mlp', 'embed'), 'fan_in'),
+        }
+
+    def apply(self, params, x):
+        b, s, d = x.shape
+        e = self.n_experts
+        cap = max(1, int(self.capacity_factor * s * self.top_k / e))
+
+        logits = self.router.apply(params['router'],
+                                   x.astype(jnp.float32))   # [b,s,e]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k expert choice per token
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [b,s,k]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+        # position of each (token, choice) in its expert's buffer via
+        # cumulative count over the flattened (s*k) routing sequence
+        choice_oh = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # [b,s,k,e]
+        flat = choice_oh.reshape(b, s * self.top_k, e)
+        pos = jnp.cumsum(flat, axis=1) - flat                 # [b,sk,e]
+        pos = jnp.sum(pos * flat, axis=-1).reshape(b, s, self.top_k)
+        in_cap = pos < cap
+
+        # dispatch/combine tensors [b, s, k, e, cap] -> summed over k
+        pos_oh = jax.nn.one_hot(pos, cap, dtype=self.dtype)   # [b,s,k,cap]
+        disp = (choice_oh.astype(self.dtype)[..., None] *
+                pos_oh[..., None, :] *
+                in_cap[..., None, None].astype(self.dtype))   # [b,s,k,e,cap]
+        combine = disp * gate_vals[..., None, None].astype(self.dtype)
+        disp = jnp.sum(disp, axis=2)                          # [b,s,e,cap]
+        combine = jnp.sum(combine, axis=2)                    # [b,s,e,cap]
+
+        xe = jnp.einsum('bsec,bsd->becd', disp, x.astype(self.dtype))
+        xe = constrain(xe, ('batch', 'expert', None, 'embed'))
+        h = self.act(jnp.einsum('becd,edh->bech', xe,
+                                params['up'].astype(self.dtype)))
+        h = constrain(h, ('batch', 'expert', None, 'mlp'))
+        ye = jnp.einsum('bech,ehd->becd', h,
+                        params['down'].astype(self.dtype))
+        y = jnp.einsum('bsec,becd->bsd', combine, ye)
+
+        # load-balance aux loss (Switch eq. 4): e * sum_e f_e * P_e
+        f = jnp.mean(jnp.sum(choice_oh[:, :, 0], axis=1).astype(
+            jnp.float32) / s, axis=0)                         # [e]
+        p = jnp.mean(probs, axis=(0, 1))
+        self_aux = e * jnp.sum(f * p)
+        return y, self_aux

@@ -1,0 +1,239 @@
+"""Transformer language model — the framework's flagship model family.
+
+Covers the reference's BERT-large benchmark role (BASELINE.md: BERT-large
+tokens/s) and the lm1b LSTM example's role as the language-model case,
+built TPU-first: bfloat16 matmuls on the MXU, logical-axis sharding for
+DP/TP/SP/EP, ring attention for long context, remat-friendly block
+structure (scan-over-layers so XLA compiles one block).
+"""
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.const import AXIS_PIPELINE, AXIS_SEQUENCE
+from autodist_tpu.models.attention import MultiHeadAttention
+from autodist_tpu.models.core import (Dense, Embedding, LayerNorm, Mlp,
+                                      Module, ParamDef, constrain)
+from autodist_tpu.parallel.axes import ctx_option, manual_axis
+
+
+@dataclass
+class TransformerConfig:
+    vocab: int = 32000
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    causal: bool = True
+    tied_embeddings: bool = True
+    dtype: object = jnp.bfloat16
+    remat: bool = False          # checkpoint each block
+    scan_layers: bool = True     # stack blocks + lax.scan (1 compile/block)
+    moe_experts: int = 0         # >0: MoE MLP with this many experts
+    moe_top_k: int = 2
+    moe_aux_coef: float = 0.01   # load-balance loss weight
+
+    @classmethod
+    def bert_large(cls, **kw):
+        """BERT-large class config (24L/1024d/16h) — reference headline
+        pre-training model (docs/usage/performance.md:7)."""
+        d = dict(vocab=30522, dim=1024, n_layers=24, n_heads=16,
+                 causal=False, max_len=512)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def gpt_small(cls, **kw):
+        d = dict(vocab=32000, dim=768, n_layers=12, n_heads=12,
+                 causal=True, max_len=1024)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab=256, dim=64, n_layers=2, n_heads=4, max_len=128)
+        d.update(kw)
+        return cls(**d)
+
+
+class Block(Module):
+    """Pre-LN transformer block; MoE MLP when cfg.moe_experts > 0.
+
+    ``apply`` returns ``(x, aux)`` where aux is the router load-balance
+    loss contribution (0.0 for dense blocks)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.ln1 = LayerNorm(cfg.dim, dtype=cfg.dtype)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.n_heads,
+                                       causal=cfg.causal, dtype=cfg.dtype)
+        self.ln2 = LayerNorm(cfg.dim, dtype=cfg.dtype)
+        if cfg.moe_experts:
+            from autodist_tpu.models.moe import MoeMlp
+            self.mlp = MoeMlp(cfg.dim, cfg.dim * cfg.mlp_ratio,
+                              cfg.moe_experts, top_k=cfg.moe_top_k,
+                              dtype=cfg.dtype)
+        else:
+            self.mlp = Mlp(cfg.dim, cfg.dim * cfg.mlp_ratio,
+                           dtype=cfg.dtype)
+
+    def param_defs(self):
+        return {'ln1': self.ln1, 'attn': self.attn,
+                'ln2': self.ln2, 'mlp': self.mlp}
+
+    def apply(self, params, x):
+        x = x + self.attn.apply(params['attn'],
+                                self.ln1.apply(params['ln1'], x))
+        h = self.mlp.apply(params['mlp'],
+                           self.ln2.apply(params['ln2'], x))
+        aux = jnp.zeros((), jnp.float32)
+        if self.cfg.moe_experts:
+            h, aux = h
+        x = x + h
+        return constrain(x, ('batch', 'seq', 'embed')), aux
+
+
+class TransformerLM(Module):
+    """Embedding -> N blocks -> final LN -> logits.
+
+    With ``scan_layers`` the block params are stacked along a leading
+    ``stage`` logical axis and the forward is a ``lax.scan`` — one
+    compiled block regardless of depth, and the natural substrate for
+    pipeline parallelism (the ``stage`` axis shards over ``pipe``).
+    """
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.embed = Embedding(cfg.vocab, cfg.dim, dtype=cfg.dtype)
+        # 'pos' is deliberately unmapped (replicated): in sequence-parallel
+        # mode every shard looks up its own global positions locally.
+        self.pos_embed = Embedding(cfg.max_len, cfg.dim,
+                                   vocab_axis='pos', dtype=cfg.dtype)
+        self.block = Block(cfg)
+        self.ln_f = LayerNorm(cfg.dim, dtype=cfg.dtype)
+        if not cfg.tied_embeddings:
+            self.lm_head = Dense(cfg.dim, cfg.vocab, 'embed', 'vocab',
+                                 use_bias=False, dtype=cfg.dtype)
+
+    def param_defs(self):
+        d = {'embed': self.embed, 'pos_embed': self.pos_embed,
+             'ln_f': self.ln_f}
+        if not self.cfg.tied_embeddings:
+            d['lm_head'] = self.lm_head
+        if self.cfg.scan_layers:
+            d['blocks'] = _Stacked(self.block, self.cfg.n_layers)
+        else:
+            for i in range(self.cfg.n_layers):
+                d['block_%03d' % i] = self.block
+        return d
+
+    def apply(self, params, tokens):
+        return self.apply_with_aux(params, tokens)[0]
+
+    def apply_with_aux(self, params, tokens):
+        """Returns (logits, aux) where aux is the summed MoE router
+        load-balance loss (0.0 for dense configs)."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        x = self.embed.apply(params['embed'], tokens)
+        # global positions: offset by the manual seq-shard index when the
+        # sequence axis runs inside shard_map (ring attention mode)
+        seq_axis = manual_axis(AXIS_SEQUENCE)
+        pos = jnp.arange(s)
+        if seq_axis is not None:
+            pos = pos + jax.lax.axis_index(seq_axis) * s
+        x = x + self.pos_embed.apply(params['pos_embed'], pos)[None]
+        x = constrain(x, ('batch', 'seq', 'embed'))
+
+        block_fn = self.block.apply
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+        aux_total = jnp.zeros((), jnp.float32)
+        pipe_axis = manual_axis(AXIS_PIPELINE)
+        if pipe_axis is not None:
+            if not cfg.scan_layers:
+                raise ValueError(
+                    'pipeline parallelism requires scan_layers=True '
+                    '(blocks must be stage-stacked to shard over pipe)')
+            from autodist_tpu.parallel.pipeline import gpipe
+            # aux (MoE balance) loss is dropped under pipelining: the
+            # GPipe carry is the activation alone
+            x = gpipe(lambda p, h: block_fn(p, h)[0], params['blocks'],
+                      x, pipe_axis, ctx_option('microbatches', 1))
+        elif cfg.scan_layers:
+            def body(carry, layer_params):
+                h, aux = carry
+                h, a = block_fn(layer_params, h)
+                return (h, aux + a), None
+            (x, aux_total), _ = jax.lax.scan(
+                body, (x, aux_total), params['blocks'])
+        else:
+            for i in range(cfg.n_layers):
+                x, a = block_fn(params['block_%03d' % i], x)
+                aux_total = aux_total + a
+        x = self.ln_f.apply(params['ln_f'], x)
+        if cfg.tied_embeddings:
+            logits = self.embed.attend(params['embed'], x)
+        else:
+            logits = self.lm_head.apply(params['lm_head'], x)
+        return constrain(logits.astype(jnp.float32),
+                         ('batch', 'seq', 'vocab')), aux_total
+
+    def per_token_loss(self, params, batch):
+        return self.per_token_loss_with_aux(params, batch)[0]
+
+    @property
+    def aux_loss_weight(self):
+        return self.cfg.moe_aux_coef if self.cfg.moe_experts else 0.0
+
+    def per_token_loss_with_aux(self, params, batch):
+        """([batch, seq] token NLL, aux loss); expects {'tokens',
+        'targets'}.
+
+        Shape-preserving on purpose: in sequence-parallel mode this runs
+        inside shard_map over local seq shards and the trainer reduces.
+        Under SP, MoE routing groups are the local seq shards (GShard
+        grouping), so capacity/dropping is per-shard."""
+        logits, aux = self.apply_with_aux(params, batch['tokens'])
+        targets = batch['targets']
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        # one-hot contraction, not take_along_axis: partitions cleanly
+        # when the vocab dim is tensor-sharded
+        gold = jnp.sum(logits * jax.nn.one_hot(targets, logits.shape[-1],
+                                               dtype=logits.dtype), axis=-1)
+        return logz - gold, aux
+
+    def loss(self, params, batch):
+        """Mean token cross-entropy (+ MoE balance loss), optional mask."""
+        nll, aux = self.per_token_loss_with_aux(params, batch)
+        mask = batch.get('mask')
+        if mask is not None:
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        else:
+            ce = jnp.mean(nll)
+        return ce + self.cfg.moe_aux_coef * aux
+
+
+class _Stacked(Module):
+    """A module's params stacked n times along a leading 'stage' axis."""
+
+    def __init__(self, inner, n):
+        self.inner = inner
+        self.n = n
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n)
+        return jax.vmap(self.inner.init)(keys)
+
+    def axes(self):
+        inner_axes = self.inner.axes()
+        return jax.tree.map(
+            lambda a: ('stage',) + tuple(a),
+            inner_axes,
+            is_leaf=lambda x: isinstance(x, tuple) and
+            all(isinstance(v, (str, type(None))) for v in x))
+
+    def param_defs(self):  # pragma: no cover - init/axes overridden
+        return {'inner': self.inner}

@@ -1,0 +1,43 @@
+"""On-demand builder for the framework's native (C++) components.
+
+Sources live in ``native/``; binaries/libraries are cached under
+``/tmp/autodist-tpu/native/<source-hash>/`` so rebuilds happen only when
+the source changes. Uses plain g++ (present in the supported images); a
+``make``-based flow is equivalent (see native/Makefile).
+"""
+import hashlib
+import os
+import subprocess
+
+from autodist_tpu.const import DEFAULT_WORKING_DIR
+from autodist_tpu.utils import logging
+
+NATIVE_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'native')
+NATIVE_CACHE_DIR = os.path.join(DEFAULT_WORKING_DIR, 'native')
+
+
+def _src_path(name):
+    return os.path.join(NATIVE_SRC_DIR, name)
+
+
+def build(source_name, output_name=None, shared=False, extra_flags=()):
+    """Compile ``native/<source_name>`` and return the artifact path."""
+    src = _src_path(source_name)
+    with open(src, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out_name = output_name or os.path.splitext(source_name)[0]
+    if shared:
+        out_name += '.so'
+    out_dir = os.path.join(NATIVE_CACHE_DIR, digest)
+    out = os.path.join(out_dir, out_name)
+    if os.path.exists(out):
+        return out
+    os.makedirs(out_dir, exist_ok=True)
+    cmd = ['g++', '-O2', '-std=c++17', '-pthread']
+    if shared:
+        cmd += ['-shared', '-fPIC']
+    cmd += list(extra_flags) + [src, '-o', out]
+    logging.info('Building native component: %s', ' '.join(cmd))
+    subprocess.run(cmd, check=True)
+    return out

@@ -1,0 +1,78 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pipe`` axis.
+
+Absent from the reference (SURVEY.md §2.3: PP = No). TPU-native design:
+the repeated transformer blocks are parameter-stacked along a leading
+``stage`` axis which shards over the ``pipe`` mesh axis; inside a manual
+shard_map region each pipe rank scans its local layer shard, and
+activations hop stage-to-stage with ``ppermute`` following the GPipe
+schedule (microbatches fill/drain the pipe; bubble fraction
+(pp-1)/(M+pp-1)). Autodiff through ppermute gives the backward schedule
+for free; XLA overlaps the hop DMA with the next microbatch's compute.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
+    """Run a stage-sharded layer stack as a GPipe pipeline.
+
+    Must be called inside a shard_map region manual over ``axis_name``.
+
+    Args:
+        block_fn: ``block_fn(layer_params, h) -> h`` single-block apply.
+        stacked_params: pytree with local leading dim = layers_per_stage.
+        x: [batch, ...] full activation batch (replicated over the pipe
+            axis — every rank holds it; only rank 0's copy is consumed).
+        axis_name: the pipe mesh axis.
+        microbatches: M, the microbatch count (batch must divide by M).
+
+    Returns:
+        [batch, ...] final activations, replicated over the pipe axis.
+    """
+    pp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = int(microbatches)
+    assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    def local_stack(h):
+        def body(c, p):
+            return block_fn(p, c), None
+        h, _ = lax.scan(body, h, stacked_params)
+        return h
+
+    if pp == 1:
+        return local_stack(x)
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def step(carry, t):
+        state, buf = carry
+        # stage 0 consumes microbatch t (clamped in the drain phase);
+        # other stages consume what the previous stage sent
+        mb_idx = jnp.clip(t, 0, M - 1)
+        first_in = lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
+        inp = jnp.where(rank == 0, first_in, state)
+        out = local_stack(inp)
+        # last stage records microbatch t-(pp-1) once the pipe is full
+        out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+        ready = jnp.logical_and(rank == pp - 1, t >= pp - 1)
+        prev = lax.dynamic_index_in_dim(buf, out_idx, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.where(ready, out, prev), out_idx, 0)
+        nxt = lax.ppermute(out, axis_name, fwd_perm)
+        return (nxt, buf), None
+
+    state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    buf = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
+    (_, buf), _ = lax.scan(step, (state, buf),
+                           jnp.arange(M + pp - 1))
+    out = buf.reshape(B, *x.shape[1:])
+    # broadcast the last stage's result to every rank (the head/loss run
+    # replicated over pipe): mask + psum
+    out = lax.psum(
+        jnp.where(rank == pp - 1, out, jnp.zeros_like(out)), axis_name)
+    return out

@@ -1,0 +1,147 @@
+"""Client + process manager for the native coordination service.
+
+The service (native/coord_service.cc) provides the between-program
+control plane: barriers, counters, bounded-staleness windows, heartbeats.
+See the source header for the protocol. The chief starts one instance
+(:func:`ensure_service`); every process connects with
+:class:`CoordClient`.
+
+Bounded staleness (reference semantics, ps_synchronizer.py:387-458 and
+the c9 timing contract): each worker publishes its step counter under
+``step/<worker>``; before running step ``s`` a worker calls
+:meth:`staleness_gate`, which blocks until ``min(all steps) >= s -
+staleness``. A fast worker can thus run at most ``staleness`` steps ahead
+— the queue-capacity semantics without TF FIFO queues.
+"""
+import socket
+import subprocess
+import time
+
+from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
+from autodist_tpu.utils import logging
+
+
+def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0):
+    """Start the native service on this host if nothing is listening."""
+    try:
+        CoordClient(('127.0.0.1', port), timeout=0.5).ping()
+        return None  # already running
+    except OSError:
+        pass
+    from autodist_tpu.native_build import build
+    binary = build('coord_service.cc')
+    proc = subprocess.Popen([binary, str(port)],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        try:
+            CoordClient(('127.0.0.1', port), timeout=0.5).ping()
+            logging.info('coord_service started on :%d (pid %d)',
+                         port, proc.pid)
+            return proc
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError('coord_service failed to start on :%d' % port)
+
+
+class CoordClient:
+    """Blocking line-protocol client."""
+
+    def __init__(self, address=None, timeout=None):
+        if address is None:
+            raw = ENV.AUTODIST_COORD_SERVICE_ADDR.val
+            if raw:
+                host, port = raw.rsplit(':', 1)
+                address = (host, int(port))
+            else:
+                address = ('127.0.0.1', DEFAULT_COORD_PORT)
+        self._sock = socket.create_connection(address, timeout=timeout)
+        self._buf = b''
+
+    def _rpc(self, line):
+        self._sock.sendall(line.encode() + b'\n')
+        while b'\n' not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise OSError('coord_service closed connection')
+            self._buf += chunk
+        resp, self._buf = self._buf.split(b'\n', 1)
+        return resp.decode()
+
+    # -- primitives --------------------------------------------------------
+    def ping(self):
+        assert self._rpc('PING') == 'PONG'
+
+    def set(self, key, value):
+        assert self._rpc('SET %s %s' % (key, value)) == 'OK'
+
+    def get(self, key):
+        resp = self._rpc('GET %s' % key)
+        return None if resp == 'NONE' else resp[4:]
+
+    def delete(self, key):
+        self._rpc('DEL %s' % key)
+
+    def incr(self, key, delta=1):
+        resp = self._rpc('INCR %s %d' % (key, delta))
+        return int(resp[4:])
+
+    def wait_ge(self, key, n, timeout_s=60.0):
+        self._sock.settimeout(timeout_s + 5.0)
+        resp = self._rpc('WAITGE %s %d %d' % (key, n,
+                                              int(timeout_s * 1000)))
+        if resp == 'TIMEOUT':
+            raise TimeoutError('wait_ge(%s, %d)' % (key, n))
+        return int(resp[4:])
+
+    def min_wait(self, prefix, n, k, timeout_s=60.0):
+        self._sock.settimeout(timeout_s + 5.0)
+        resp = self._rpc('MINWAIT %s %d %d %d' %
+                         (prefix, n, k, int(timeout_s * 1000)))
+        if resp == 'TIMEOUT':
+            raise TimeoutError('min_wait(%s, %d)' % (prefix, n))
+        return int(resp[4:])
+
+    def barrier(self, name, parties, timeout_s=60.0):
+        self._sock.settimeout(timeout_s + 5.0)
+        resp = self._rpc('BARRIER %s %d %d' %
+                         (name, parties, int(timeout_s * 1000)))
+        if resp == 'TIMEOUT':
+            raise TimeoutError('barrier(%s, %d)' % (name, parties))
+
+    def shutdown(self):
+        try:
+            self._rpc('SHUTDOWN')
+        except OSError:
+            pass
+
+    def close(self):
+        self._sock.close()
+
+    # -- composite: bounded staleness -------------------------------------
+    def publish_step(self, worker, step):
+        """Publish this worker's completed-step counter."""
+        cur = self.incr('step/%s' % worker, 0)
+        if step > cur:
+            self.incr('step/%s' % worker, step - cur)
+
+    def staleness_gate(self, step, staleness, num_workers,
+                       timeout_s=600.0):
+        """Block until every worker is within ``staleness`` steps."""
+        if step <= staleness:
+            return
+        self.min_wait('step/', step - staleness, num_workers, timeout_s)
+
+    # -- composite: heartbeat / failure detection --------------------------
+    def heartbeat(self, worker):
+        self.set('hb/%s' % worker, str(time.time()))
+
+    def dead_workers(self, workers, timeout_s):
+        now = time.time()
+        dead = []
+        for w in workers:
+            raw = self.get('hb/%s' % w)
+            if raw is None or now - float(raw) > timeout_s:
+                dead.append(w)
+        return dead

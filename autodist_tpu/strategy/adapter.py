@@ -1,0 +1,156 @@
+"""Bridge: reference-style strategies over functional (pytree) models.
+
+The reference's builders analyze a captured tf.Graph (SURVEY.md §2.1);
+the functional path has no graph, just a param pytree with logical-axis
+metadata. :class:`PytreeGraphItem` adapts that pytree to the GraphItem
+interface the builders consume (``trainable_var_op_to_var`` +
+``is_sparse``), so ALL eight builders run unchanged on functional models.
+
+:func:`apply_strategy_to_trainer_shardings` then lowers the built
+strategy onto Trainer shardings: a variable the strategy partitions gets
+its state sharded over the ``data`` axis along the strategy's partition
+axis (the ZeRO realization of PS placement; SURVEY.md §7 design
+translation table), while AllReduce variables stay replicated (GSPMD
+inserts the gradient psum).
+"""
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import jax
+
+from autodist_tpu.const import AXIS_DATA
+from autodist_tpu.strategy.base import PSSynchronizer
+from autodist_tpu.utils import logging
+
+
+class _VarLike:
+    """Duck-typed Variable for strategy builders (shape/dtype/name)."""
+
+    def __init__(self, name, shape, dtype, sparse=False):
+        self.name = name
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.sparse_read = sparse
+
+    @property
+    def nbytes(self):
+        n = self.dtype.itemsize
+        for d in self.shape:
+            n *= d
+        return n
+
+
+class PytreeGraphItem:
+    """GraphItem facade over a functional model's param pytree.
+
+    Variables are named by their pytree path (``'blocks/mlp/up/kernel'``).
+    A leaf whose logical axes include ``vocab`` is flagged sparse —
+    embedding tables get gather-style (IndexedSlices-like) gradients,
+    which is what Parallax keys its dense/sparse split on
+    (parallax_strategy.py:38-70).
+    """
+
+    def __init__(self, model, rng=None):
+        self.model = model
+        shapes = jax.eval_shape(model.init,
+                                rng if rng is not None
+                                else jax.random.PRNGKey(0))
+        axes = model.axes()
+        self._vars = {}
+        flat_s = _flatten_with_paths(shapes)
+        flat_a = dict(_flatten_with_paths(
+            axes, is_leaf=lambda x: x is None or (
+                isinstance(x, tuple) and
+                all(isinstance(a, (str, type(None))) for a in x))))
+        for path, leaf in flat_s:
+            ax = flat_a.get(path) or ()
+            self._vars[path] = _VarLike(
+                path, leaf.shape, leaf.dtype,
+                sparse='vocab' in ax)
+
+    @property
+    def trainable_var_op_to_var(self):
+        return self._vars
+
+    def is_sparse(self, var):
+        return var.sparse_read
+
+    def var_by_name(self, name):
+        return self._vars[name]
+
+    def prepare(self):
+        return self
+
+
+def _flatten_with_paths(tree, is_leaf=None):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    out = []
+    for path, leaf in flat:
+        name = '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                        for k in path)
+        out.append((name, leaf))
+    return out
+
+
+def apply_strategy_to_shardings(strategy, graph_item, shardings, mesh):
+    """Refine a Trainer sharding tree according to a built Strategy.
+
+    Partitioned (PS or AR) variables: state shards over ``data`` along the
+    strategy's partition axis when divisible. Plain PS variables with no
+    partitioning stay replicated (a single logical server is the
+    degenerate shard). Returns a new sharding pytree.
+    """
+    nodes = {n.var_name: n for n in strategy.node_config}
+    flat = dict(_flatten_with_paths(shardings,
+                                    is_leaf=lambda x: isinstance(
+                                        x, NamedSharding)))
+    dp = mesh.shape.get(AXIS_DATA, 1)
+    out = {}
+    for name, sharding in flat.items():
+        node = nodes.get(name)
+        out[name] = sharding
+        if node is None or dp <= 1:
+            continue
+        var = graph_item.var_by_name(name)
+        axis = node.partition_axis
+        if axis is None:
+            continue
+        spec = list(sharding.spec) + [None] * (len(var.shape) -
+                                               len(sharding.spec))
+        if spec[axis] is None and var.shape[axis] % dp == 0 and \
+                var.shape[axis] >= dp:
+            spec[axis] = AXIS_DATA
+            out[name] = NamedSharding(mesh, P(*spec))
+        else:
+            logging.debug('Cannot shard %s axis %d over data (%s)',
+                          name, axis, var.shape)
+    # rebuild the tree in the original structure
+    leaves, treedef = jax.tree_util.tree_flatten(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    names = [n for n, _ in _flatten_with_paths(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))]
+    return jax.tree_util.tree_unflatten(
+        treedef, [out[n] for n in names])
+
+
+def trainer_from_strategy(model, optimizer, strategy_builder,
+                          resource_spec=None, spec=None, **kw):
+    """Build a Trainer whose state shardings follow a reference-style
+    strategy built by ``strategy_builder`` over the model's pytree."""
+    from autodist_tpu.api import Trainer
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    gi = PytreeGraphItem(model)
+    if resource_spec is None:
+        import jax as _jax
+        n = len(_jax.devices())
+        resource_spec = ResourceSpec(resource_info={'nodes': [{
+            'address': 'localhost', 'chief': True, 'cpus': [0],
+            'gpus': list(range(n)), 'network_bandwidth': 100}]})
+    strategy = strategy_builder.build(gi, resource_spec)
+    trainer = Trainer(model, optimizer, spec=spec, **kw)
+    trainer.param_shardings = apply_strategy_to_shardings(
+        strategy, gi, trainer.param_shardings, trainer.mesh)
+    trainer.strategy = strategy
+    return trainer

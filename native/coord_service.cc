@@ -1,0 +1,229 @@
+// Coordination service: TCP key/value + counters + barriers.
+//
+// TPU-native replacement for the control-plane primitives the reference
+// gets from the TF C++ runtime (SURVEY.md §2.2): FIFO token queues for
+// sync barriers and bounded staleness (ps_synchronizer.py:335-458) and
+// the chief/worker rendezvous that tf.Server+grpc provided. SPMD
+// collectives need none of this inside a program; this service covers the
+// *between-program* coordination: multi-process barriers, bounded-
+// staleness windows (each worker publishes its step; a worker may run
+// ahead only while min_step >= my_step - staleness), heartbeats for
+// fail-fast monitoring, and small metadata exchange (strategy ids).
+//
+// Protocol: newline-terminated text commands over TCP.
+//   SET <key> <value>            -> OK
+//   GET <key>                    -> VAL <value> | NONE
+//   DEL <key>                    -> OK
+//   INCR <key> <delta>           -> VAL <n>        (atomic add, int64)
+//   WAITGE <key> <n> <ms>        -> VAL <m> | TIMEOUT   (wait key >= n)
+//   MINWAIT <prefix> <n> <k> <ms>-> VAL <min> | TIMEOUT
+//       (wait until >=k keys share <prefix> and their min value >= n)
+//   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
+//   PING                         -> PONG
+//   SHUTDOWN                     -> OK (server exits)
+//
+// Build: g++ -O2 -std=c++17 -pthread -o coord_service coord_service.cc
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, int64_t> counters;
+  std::map<std::string, int64_t> barrier_arrivals;
+  std::map<std::string, int64_t> barrier_generation;
+  std::atomic<bool> shutting_down{false};
+};
+
+Store g_store;
+
+int64_t counter_of(const std::string& key) {
+  auto it = g_store.counters.find(key);
+  return it == g_store.counters.end() ? 0 : it->second;
+}
+
+// min over counters with the prefix; count reported via out param.
+int64_t prefix_min(const std::string& prefix, int* count) {
+  int64_t min_v = INT64_MAX;
+  int n = 0;
+  for (auto it = g_store.counters.lower_bound(prefix);
+       it != g_store.counters.end() &&
+       it->first.compare(0, prefix.size(), prefix) == 0;
+       ++it) {
+    ++n;
+    if (it->second < min_v) min_v = it->second;
+  }
+  *count = n;
+  return n ? min_v : 0;
+}
+
+std::string handle(const std::string& line) {
+  std::istringstream in(line);
+  std::string cmd;
+  in >> cmd;
+  using namespace std::chrono;
+  if (cmd == "PING") return "PONG";
+  if (cmd == "SET") {
+    std::string k, v;
+    in >> k;
+    std::getline(in, v);
+    if (!v.empty() && v[0] == ' ') v.erase(0, 1);
+    std::lock_guard<std::mutex> l(g_store.mu);
+    g_store.kv[k] = v;
+    g_store.cv.notify_all();
+    return "OK";
+  }
+  if (cmd == "GET") {
+    std::string k;
+    in >> k;
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto it = g_store.kv.find(k);
+    return it == g_store.kv.end() ? "NONE" : ("VAL " + it->second);
+  }
+  if (cmd == "DEL") {
+    std::string k;
+    in >> k;
+    std::lock_guard<std::mutex> l(g_store.mu);
+    g_store.kv.erase(k);
+    g_store.counters.erase(k);
+    return "OK";
+  }
+  if (cmd == "INCR") {
+    std::string k;
+    int64_t d = 1;
+    in >> k >> d;
+    std::lock_guard<std::mutex> l(g_store.mu);
+    int64_t v = (g_store.counters[k] += d);
+    g_store.cv.notify_all();
+    return "VAL " + std::to_string(v);
+  }
+  if (cmd == "WAITGE") {
+    std::string k;
+    int64_t n = 0, ms = 0;
+    in >> k >> n >> ms;
+    std::unique_lock<std::mutex> l(g_store.mu);
+    bool ok = g_store.cv.wait_for(l, milliseconds(ms), [&] {
+      return counter_of(k) >= n || g_store.shutting_down;
+    });
+    if (!ok || g_store.shutting_down) return "TIMEOUT";
+    return "VAL " + std::to_string(counter_of(k));
+  }
+  if (cmd == "MINWAIT") {
+    std::string prefix;
+    int64_t n = 0, k = 0, ms = 0;
+    in >> prefix >> n >> k >> ms;
+    std::unique_lock<std::mutex> l(g_store.mu);
+    int count = 0;
+    bool ok = g_store.cv.wait_for(l, milliseconds(ms), [&] {
+      int c = 0;
+      int64_t m = prefix_min(prefix, &c);
+      return (c >= k && m >= n) || g_store.shutting_down;
+    });
+    if (!ok || g_store.shutting_down) return "TIMEOUT";
+    return "VAL " + std::to_string(prefix_min(prefix, &count));
+  }
+  if (cmd == "BARRIER") {
+    std::string name;
+    int64_t k = 0, ms = 0;
+    in >> name >> k >> ms;
+    std::unique_lock<std::mutex> l(g_store.mu);
+    int64_t gen = g_store.barrier_generation[name];
+    int64_t arrived = ++g_store.barrier_arrivals[name];
+    if (arrived >= k) {
+      g_store.barrier_arrivals[name] = 0;
+      ++g_store.barrier_generation[name];
+      g_store.cv.notify_all();
+      return "OK";
+    }
+    bool ok = g_store.cv.wait_for(l, milliseconds(ms), [&] {
+      return g_store.barrier_generation[name] != gen ||
+             g_store.shutting_down;
+    });
+    return (ok && !g_store.shutting_down) ? "OK" : "TIMEOUT";
+  }
+  if (cmd == "SHUTDOWN") {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    g_store.shutting_down = true;
+    g_store.cv.notify_all();
+    return "OK";
+  }
+  return "ERR unknown command";
+}
+
+void serve_conn(int fd) {
+  std::string buf;
+  char chunk[4096];
+  while (!g_store.shutting_down) {
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) break;
+    buf.append(chunk, n);
+    size_t pos;
+    while ((pos = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, pos);
+      buf.erase(0, pos + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string resp = handle(line) + "\n";
+      if (send(fd, resp.data(), resp.size(), 0) < 0) {
+        close(fd);
+        return;
+      }
+      if (g_store.shutting_down) {  // reply sent; exit promptly —
+        close(fd);                  // accept() would otherwise block
+        _exit(0);
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 14999;
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(port);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "coord_service listening on :%d\n", port);
+  fflush(stderr);
+  std::vector<std::thread> threads;
+  while (!g_store.shutting_down) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) break;
+    threads.emplace_back(serve_conn, fd);
+  }
+  close(srv);
+  for (auto& t : threads)
+    if (t.joinable()) t.detach();
+  return 0;
+}

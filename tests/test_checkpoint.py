@@ -1,0 +1,132 @@
+"""Checkpoint suite (mirrors reference tests/checkpoint/):
+
+- saver round-trip under a partitioning strategy, restored into a
+  *different* distribution setup (the single-node-compatibility contract,
+  test_partitionedPS_saver.py / saver.py:50-57);
+- CheckpointManager retention;
+- SavedModel export;
+- functional-path save/restore across different meshes.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import autodist_tpu as ad
+from autodist_tpu.api import Trainer
+from autodist_tpu.checkpoint.saver import (CheckpointManager, Saver,
+                                           SavedModelBuilder, load_pytree,
+                                           save_pytree)
+from autodist_tpu.models.transformer import TransformerConfig, TransformerLM
+from autodist_tpu.parallel.axes import ParallelSpec
+from autodist_tpu.strategy import AllReduce, PartitionedPS
+
+
+def resource_info(n=8):
+    return {'nodes': [{'address': 'localhost', 'gpus': list(range(n)),
+                       'chief': True, 'network_bandwidth': 100}]}
+
+
+def _build_session(strategy_builder, n=8):
+    # emulate a fresh program lifecycle (reference test_all.py:55-70
+    # forks per case; one AutoDist per process is a hard parity rule)
+    from autodist_tpu import autodist as ad_mod
+    ad_mod._DEFAULT_AUTODIST.clear()
+    autodist = ad.AutoDist(resource_info=resource_info(n),
+                           strategy_builder=strategy_builder)
+    graph = autodist.scope()
+    with graph:
+        x = ad.placeholder(shape=[None, 4], dtype=np.float32, name='x')
+        W = ad.Variable(np.arange(8, dtype=np.float32).reshape(4, 2),
+                        name='W')
+        b = ad.Variable(np.zeros(2, np.float32), name='b')
+        loss = ad.ops.reduce_mean(ad.ops.square(x @ W + b))
+        train_op = ad.optimizers.SGD(0.1).minimize(loss)
+        saver = Saver()
+        sess = autodist.create_distributed_session()
+    return sess, saver, (x, loss, train_op)
+
+
+def test_saver_roundtrip_across_strategies(tmp_path):
+    """Save under PartitionedPS, restore under AllReduce: logical layout."""
+    sess, saver, (x, loss, train_op) = _build_session(PartitionedPS())
+    sess.run([loss, train_op], {x: np.ones((8, 4), np.float32)})
+    w_after = sess.get_variable_value('W')
+    path = str(tmp_path / 'ckpt')
+    saver.save(sess, path)
+    sess.close()
+
+    sess2, saver2, _ = _build_session(AllReduce())
+    saver2.restore(sess2, path)
+    assert np.allclose(sess2.get_variable_value('W'), w_after)
+    sess2.close()
+
+
+def test_saver_checkpoint_is_logical_npy(tmp_path):
+    sess, saver, _ = _build_session(AllReduce())
+    path = str(tmp_path / 'ckpt')
+    saver.save(sess, path, global_step=7)
+    tensors, step = load_pytree(path + '-7')
+    assert step == 7
+    assert tensors['W'].shape == (4, 2)  # original unpartitioned layout
+    sess.close()
+
+
+def test_checkpoint_manager_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / 'ckpts'), max_to_keep=2)
+    for s in (1, 2, 3):
+        mgr.save(s, {'a': np.full((2,), s, np.float32)})
+    assert mgr.all_steps() == [2, 3]
+    tree, step = mgr.restore(like={'a': np.zeros((2,), np.float32)})
+    assert step == 3 and np.allclose(tree['a'], 3)
+
+
+def test_saved_model_builder(tmp_path):
+    sess, _, _ = _build_session(AllReduce())
+    export = str(tmp_path / 'export')
+    b = SavedModelBuilder(export)
+    b.add_meta_graph_and_variables(sess, tags=['serve'])
+    b.save()
+    assert os.path.exists(os.path.join(export, 'saved_model.json'))
+    tensors, _ = load_pytree(os.path.join(export, 'variables'))
+    assert 'W' in tensors
+    sess.close()
+
+
+def test_functional_state_roundtrip_across_meshes(tmp_path):
+    """Trainer state saved on a tp=2 mesh restores onto a dp mesh."""
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=2)
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    batch = {'tokens': rng.randint(0, 256, (8, 32)),
+             'targets': rng.randint(0, 256, (8, 32))}
+
+    tr1 = Trainer(model, optax.sgd(0.1), spec=ParallelSpec(tp=2))
+    s1 = tr1.init(jax.random.PRNGKey(0))
+    s1, _ = tr1.step(s1, batch)
+    path = str(tmp_path / 'state')
+    save_pytree(path, tr1.get_params(s1), step=1)
+
+    tr2 = Trainer(model, optax.sgd(0.1), spec=ParallelSpec())
+    host_params, step = load_pytree(path,
+                                    like=jax.eval_shape(
+                                        model.init, jax.random.PRNGKey(0)))
+    s2 = tr2.init(jax.random.PRNGKey(0), params=host_params)
+    assert step == 1
+    # identical forward loss from the restored params
+    l1 = float(model.loss(tr1.get_params(s1),
+                          {k: jnp.asarray(v) for k, v in batch.items()}))
+    l2 = float(model.loss(tr2.get_params(s2),
+                          {k: jnp.asarray(v) for k, v in batch.items()}))
+    assert np.allclose(l1, l2, atol=1e-5)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    path = str(tmp_path / 'ckpt')
+    save_pytree(path, {'a': np.zeros((2, 3), np.float32)})
+    with pytest.raises(ValueError):
+        load_pytree(path, like={'a': np.zeros((3, 2), np.float32)})
