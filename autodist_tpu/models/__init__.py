@@ -1,1 +1,15 @@
-"""models subpackage."""
+"""Model zoo: the reference's benchmark families, TPU-native.
+
+- transformer: TransformerLM (BERT-large/GPT configs, MoE option)
+- vision: ResNet50/101/152, VGG16, DenseNet121, InceptionV3
+- rnn: LSTMLM (lm1b role)
+- ncf: NCF recommender (sparse embeddings role)
+"""
+from autodist_tpu.models.core import (Dense, Embedding, LayerNorm,  # noqa: F401
+                                      Mlp, Module, ParamDef, Sequential)
+from autodist_tpu.models.transformer import (TransformerConfig,  # noqa: F401
+                                             TransformerLM)
+from autodist_tpu.models.rnn import LSTMLM  # noqa: F401
+from autodist_tpu.models.ncf import NCF  # noqa: F401
+from autodist_tpu.models.vision import (DenseNet, InceptionV3, ResNet,  # noqa: F401
+                                        VGG)

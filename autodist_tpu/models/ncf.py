@@ -1,0 +1,58 @@
+"""Neural Collaborative Filtering (reference examples/benchmark/ncf.py
+role): GMF + MLP towers over user/item embeddings, binary logloss.
+
+The embedding tables are the reference's canonical sparse-variable case
+(PSLoadBalancing + partitioned embeddings); their ``vocab`` logical axis
+marks them sparse for the Parallax/PartitionedPS builders via the pytree
+adapter, and shards them over ``model`` under tensor parallelism.
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models.core import Dense, Embedding, Module
+
+
+class NCF(Module):
+    def __init__(self, num_users, num_items, mf_dim=64,
+                 mlp_dims=(256, 128, 64), dtype=jnp.float32):
+        self.num_users, self.num_items = num_users, num_items
+        self.mf_dim = mf_dim
+        self.dtype = dtype
+        self.mf_user = Embedding(num_users, mf_dim, dtype=dtype)
+        self.mf_item = Embedding(num_items, mf_dim, dtype=dtype)
+        mlp_in = mlp_dims[0]
+        self.mlp_user = Embedding(num_users, mlp_in // 2, dtype=dtype)
+        self.mlp_item = Embedding(num_items, mlp_in // 2, dtype=dtype)
+        self.mlp = []
+        for i in range(1, len(mlp_dims)):
+            self.mlp.append(Dense(mlp_dims[i - 1], mlp_dims[i],
+                                  'embed', 'mlp', dtype=dtype))
+        self.head = Dense(mf_dim + mlp_dims[-1], 1, 'embed', None,
+                          dtype=dtype)
+
+    def param_defs(self):
+        d = {'mf_user': self.mf_user, 'mf_item': self.mf_item,
+             'mlp_user': self.mlp_user, 'mlp_item': self.mlp_item,
+             'head': self.head}
+        for i, m in enumerate(self.mlp):
+            d['mlp_%d' % i] = m
+        return d
+
+    def apply(self, params, users, items):
+        gmf = self.mf_user.apply(params['mf_user'], users) * \
+            self.mf_item.apply(params['mf_item'], items)
+        y = jnp.concatenate(
+            [self.mlp_user.apply(params['mlp_user'], users),
+             self.mlp_item.apply(params['mlp_item'], items)], axis=-1)
+        for i, m in enumerate(self.mlp):
+            y = jax.nn.relu(m.apply(params['mlp_%d' % i], y))
+        both = jnp.concatenate([gmf, y], axis=-1)
+        return self.head.apply(params['head'], both)[..., 0] \
+            .astype(jnp.float32)
+
+    def loss(self, params, batch):
+        logits = self.apply(params, batch['users'], batch['items'])
+        labels = batch['labels'].astype(jnp.float32)
+        # stable sigmoid BCE
+        return jnp.mean(jnp.maximum(logits, 0) - logits * labels +
+                        jnp.log1p(jnp.exp(-jnp.abs(logits))))

@@ -1,0 +1,107 @@
+"""LSTM language model (the reference's lm1b example role,
+examples/lm1b/language_model.py) on the functional module system.
+
+TPU-first: the time dimension is a ``lax.scan`` (single compiled cell,
+no Python unrolling), gates are one fused [x,h] @ W matmul on the MXU,
+and the embedding/softmax follow the same sharding rules as the
+transformer (vocab over ``model`` when tensor parallelism is on).
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models.core import (Dense, Embedding, Module, ParamDef,
+                                      constrain)
+
+
+class LSTMCell(Module):
+    """Fused-gate LSTM cell: [x, h] @ W -> (i, f, g, o)."""
+
+    def __init__(self, in_dim, hidden, dtype=jnp.float32):
+        self.in_dim, self.hidden, self.dtype = in_dim, hidden, dtype
+
+    def param_defs(self):
+        return {
+            'kernel': ParamDef((self.in_dim + self.hidden,
+                                4 * self.hidden),
+                               ('embed', 'mlp'), 'fan_in'),
+            'bias': ParamDef((4 * self.hidden,), ('mlp',), 'zeros'),
+        }
+
+    def apply(self, params, carry, x):
+        h, c = carry
+        z = jnp.concatenate([x, h], axis=-1).astype(self.dtype)
+        gates = z @ params['kernel'].astype(self.dtype) + \
+            params['bias'].astype(self.dtype)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + \
+            jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    def init_carry(self, batch):
+        z = jnp.zeros((batch, self.hidden), self.dtype)
+        return (z, z)
+
+
+class LSTMLM(Module):
+    """Embedding -> n_layers LSTM (scan over time) -> logits."""
+
+    def __init__(self, vocab=10000, dim=512, hidden=1024, n_layers=2,
+                 tied=False, dtype=jnp.float32):
+        self.vocab, self.dim, self.hidden = vocab, dim, hidden
+        self.n_layers = n_layers
+        self.dtype = dtype
+        self.embed = Embedding(vocab, dim, dtype=dtype)
+        self.cells = [LSTMCell(dim if i == 0 else hidden, hidden,
+                               dtype=dtype) for i in range(n_layers)]
+        self.proj = Dense(hidden, dim, 'mlp', 'embed', dtype=dtype)
+        self.tied = tied
+        if not tied:
+            self.head = Dense(dim, vocab, 'embed', 'vocab',
+                              use_bias=False, dtype=dtype)
+
+    def param_defs(self):
+        d = {'embed': self.embed, 'proj': self.proj}
+        for i, c in enumerate(self.cells):
+            d['lstm_%d' % i] = c
+        if not self.tied:
+            d['head'] = self.head
+        return d
+
+    def apply(self, params, tokens):
+        b, s = tokens.shape
+        x = self.embed.apply(params['embed'], tokens)   # [b, s, d]
+        x = constrain(x, ('batch', 'seq', 'embed'))
+        y = jnp.transpose(x, (1, 0, 2))                 # time-major scan
+        for i, cell in enumerate(self.cells):
+            p = params['lstm_%d' % i]
+
+            def step(carry, xt, cell=cell, p=p):
+                return cell.apply(p, carry, xt)
+
+            _, y = jax.lax.scan(step, cell.init_carry(b), y)
+        y = jnp.transpose(y, (1, 0, 2))                 # [b, s, hidden]
+        y = self.proj.apply(params['proj'], y)
+        if self.tied:
+            logits = self.embed.attend(params['embed'], y)
+        else:
+            logits = self.head.apply(params['head'], y)
+        return logits.astype(jnp.float32)
+
+    def per_token_loss_with_aux(self, params, batch):
+        logits = self.apply(params, batch['tokens'])
+        targets = batch['targets']
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.sum(logits * jax.nn.one_hot(
+            targets, logits.shape[-1], dtype=logits.dtype), axis=-1)
+        return logz - gold, jnp.zeros((), jnp.float32)
+
+    def per_token_loss(self, params, batch):
+        return self.per_token_loss_with_aux(params, batch)[0]
+
+    def loss(self, params, batch):
+        nll, _ = self.per_token_loss_with_aux(params, batch)
+        mask = batch.get('mask')
+        if mask is not None:
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        return jnp.mean(nll)

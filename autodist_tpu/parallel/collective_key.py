@@ -1,0 +1,53 @@
+"""Collective group/instance keys (reference collective_key.py:43-70).
+
+XLA assigns channel ids automatically, so keys are not needed for
+correctness on TPU; the registry is kept because (a) strategy protos
+carry ``group`` ids that must be stable and content-addressed across
+independently-lowering workers (every worker re-derives the same fused
+buckets, SURVEY.md §1 "every worker independently re-runs the full
+transformation"), and (b) the DSL plan uses group keys to order fused
+flat-bucket collectives deterministically.
+"""
+import hashlib
+import threading
+
+from autodist_tpu.const import MAX_INT32
+
+
+class CollectiveKey:
+    """Thread-safe singleton: group keys per device-set, instance keys
+    content-addressed by variable name (md5 mod int32)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __new__(cls):
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    inst = super().__new__(cls)
+                    inst._group_keys = {}
+                    inst._next_group = 1
+                    inst._mu = threading.Lock()
+                    cls._instance = inst
+        return cls._instance
+
+    def group_key(self, devices):
+        """Stable int key for a device set (incrementing per new set)."""
+        canon = tuple(sorted(str(d) for d in devices))
+        with self._mu:
+            if canon not in self._group_keys:
+                self._group_keys[canon] = self._next_group
+                self._next_group += 1
+            return self._group_keys[canon]
+
+    @staticmethod
+    def instance_key(var_name):
+        """Content-addressed per-variable key: md5(name) mod int32."""
+        digest = hashlib.md5(var_name.encode()).hexdigest()
+        return int(digest, 16) % MAX_INT32
+
+    @classmethod
+    def _reset_for_testing(cls):
+        with cls._lock:
+            cls._instance = None

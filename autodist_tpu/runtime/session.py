@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import AXIS_DATA, DEFAULT_TRACE_DIR
+from autodist_tpu.const import AXIS_DATA, DEFAULT_TRACE_DIR, ENV
 from autodist_tpu.frontend import graph as fe
 from autodist_tpu.parallel.plan import ShardedGrad
 from autodist_tpu.utils import logging
@@ -58,6 +58,9 @@ class Session:
         self._cache = {}
         self._step_count = 0
         self._closed = False
+        # graph-mutation guard (reference autodist.py:152-165): the
+        # captured program must not grow after the session is built
+        self._built_node_count = len(graph_item.graph.nodes)
         self._init_state()
 
     # -- state ------------------------------------------------------------
@@ -129,11 +132,22 @@ class Session:
         """Execute fetches (reference WrappedSession.run, runner.py:117-132)."""
         if self._closed:
             raise RuntimeError('Session is closed')
+        if ENV.AUTODIST_IS_TESTING.val and \
+                len(self._graph_item.graph.nodes) != \
+                self._built_node_count:
+            raise RuntimeError(
+                'Graph modified after distributed session creation '
+                '(%d nodes, built with %d)' %
+                (len(self._graph_item.graph.nodes),
+                 self._built_node_count))
         feed_dict = feed_dict or {}
         single = not isinstance(fetches, (list, tuple))
         fetch_list = [fetches] if single else list(fetches)
         norm = [f.read() if isinstance(f, fe.Variable) else f
                 for f in fetch_list]
+        # fetch normalization may lazily create VariableRead nodes;
+        # those are session-internal, not user graph mutations
+        self._built_node_count = len(self._graph_item.graph.nodes)
 
         feed_nodes = sorted(feed_dict.keys(), key=lambda p: p.name)
         feed_vals = []

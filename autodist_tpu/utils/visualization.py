@@ -1,0 +1,66 @@
+"""Per-phase program dumps (reference visualization_util.py:24-36).
+
+The reference writes the TF graph to TensorBoard after each transform
+phase. The TPU equivalent of "the graph at each phase" is the jaxpr
+(after trace) and the HLO (after lowering): ``log_program`` writes both
+under ``/tmp/autodist-tpu/graphs/<run>/<phase>.{jaxpr,hlo}.txt`` when
+``AUTODIST_DUMP_GRAPHS`` is set, giving the same build-pipeline
+observability (0-original capture, 1-lowered step, ...).
+"""
+import os
+import time
+
+import jax
+
+from autodist_tpu.const import DEFAULT_GRAPH_DUMP_DIR, ENV
+from autodist_tpu.utils import logging
+
+_RUN_DIR = None
+
+
+def _run_dir():
+    global _RUN_DIR
+    if _RUN_DIR is None:
+        _RUN_DIR = os.path.join(DEFAULT_GRAPH_DUMP_DIR,
+                                time.strftime('%Y%m%d-%H%M%S'))
+        os.makedirs(_RUN_DIR, exist_ok=True)
+    return _RUN_DIR
+
+
+def log_program(fn, args, phase, kwargs=None, static_argnums=()):
+    """Dump jaxpr + (best-effort) HLO of ``fn(*args)`` for one phase."""
+    if not ENV.AUTODIST_DUMP_GRAPHS.val:
+        return None
+    kwargs = kwargs or {}
+    out_dir = _run_dir()
+    base = os.path.join(out_dir, phase)
+    try:
+        jaxpr = jax.make_jaxpr(fn, static_argnums=static_argnums)(
+            *args, **kwargs)
+        with open(base + '.jaxpr.txt', 'w') as f:
+            f.write(str(jaxpr))
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill runs
+        logging.warning('jaxpr dump failed for %s: %s', phase, e)
+    try:
+        lowered = jax.jit(fn, static_argnums=static_argnums).lower(
+            *args, **kwargs)
+        with open(base + '.hlo.txt', 'w') as f:
+            f.write(lowered.as_text())
+    except Exception as e:  # noqa: BLE001
+        logging.warning('HLO dump failed for %s: %s', phase, e)
+    logging.info('Dumped program phase %r under %s', phase, out_dir)
+    return base
+
+
+def log_compiled(lowered_or_compiled, phase):
+    """Dump an already-lowered/compiled jax artifact's HLO text."""
+    if not ENV.AUTODIST_DUMP_GRAPHS.val:
+        return None
+    base = os.path.join(_run_dir(), phase)
+    try:
+        with open(base + '.hlo.txt', 'w') as f:
+            f.write(lowered_or_compiled.as_text())
+        logging.info('Dumped %r HLO under %s', phase, _run_dir())
+    except Exception as e:  # noqa: BLE001
+        logging.warning('HLO dump failed for %s: %s', phase, e)
+    return base
