@@ -185,10 +185,13 @@ class Gradients(SymTensor):
         super().__init__(None, None, name)
         self.loss = loss
         self.sources = list(sources)
+        self._slices = None
 
     def __iter__(self):
-        return iter([GradientSlice(self, i)
-                     for i in range(len(self.sources))])
+        if self._slices is None:
+            self._slices = [GradientSlice(self, i)
+                            for i in range(len(self.sources))]
+        return iter(self._slices)
 
     def __len__(self):
         return len(self.sources)

@@ -59,9 +59,15 @@ class Session:
         self._step_count = 0
         self._closed = False
         # graph-mutation guard (reference autodist.py:152-165): the
-        # captured program must not grow after the session is built
-        self._built_node_count = len(graph_item.graph.nodes)
+        # captured program must not grow after the session is built.
+        # VariableRead nodes are excluded: they are framework-internal and
+        # created lazily (fetch normalization, jit trace of Variable.read).
+        self._built_node_count = self._user_node_count()
         self._init_state()
+
+    def _user_node_count(self):
+        return sum(1 for n in self._graph_item.graph.nodes
+                   if not isinstance(n, fe.VariableRead))
 
     # -- state ------------------------------------------------------------
     def _init_state(self):
@@ -133,21 +139,16 @@ class Session:
         if self._closed:
             raise RuntimeError('Session is closed')
         if ENV.AUTODIST_IS_TESTING.val and \
-                len(self._graph_item.graph.nodes) != \
-                self._built_node_count:
+                self._user_node_count() != self._built_node_count:
             raise RuntimeError(
                 'Graph modified after distributed session creation '
                 '(%d nodes, built with %d)' %
-                (len(self._graph_item.graph.nodes),
-                 self._built_node_count))
+                (self._user_node_count(), self._built_node_count))
         feed_dict = feed_dict or {}
         single = not isinstance(fetches, (list, tuple))
         fetch_list = [fetches] if single else list(fetches)
         norm = [f.read() if isinstance(f, fe.Variable) else f
                 for f in fetch_list]
-        # fetch normalization may lazily create VariableRead nodes;
-        # those are session-internal, not user graph mutations
-        self._built_node_count = len(self._graph_item.graph.nodes)
 
         feed_nodes = sorted(feed_dict.keys(), key=lambda p: p.name)
         feed_vals = []
