@@ -10,11 +10,13 @@ serializes the strategy, workers deserialize it by ``AUTODIST_STRATEGY_ID``
 (docs/design/architecture.rst:43-48).
 """
 import atexit
+import base64
+import json
 import os
 
 import numpy as np
 
-from autodist_tpu.const import ENV
+from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
 from autodist_tpu.frontend import graph as fe
 from autodist_tpu.graph_item import GraphItem
 from autodist_tpu.parallel.mesh import mesh_from_strategy
@@ -84,6 +86,13 @@ class AutoDist:
         self._session = None
         self._cluster = Cluster(self._resource_spec)
         self._built = False
+        self._coord = None            # coord-service client (multi-process)
+        self._coord_proc = None       # service process if we started it
+        # captured BEFORE this object mutates the env: a launcher
+        # (launch_cli / pod runtime) marks its processes with
+        # AUTODIST_PROCESS_ID; the ssh-launch chief sets it later itself.
+        self._ext_launched = \
+            os.environ.get(ENV.AUTODIST_PROCESS_ID.name) is not None
         # ad.function state
         self._fn_cache = {}
 
@@ -105,11 +114,30 @@ class AutoDist:
         if IS_AUTODIST_CHIEF:
             s = self.build_strategy()
             s.serialize()
+            if self._coord is not None:
+                # publish for same-binary (pod-style) workers that have no
+                # pre-set strategy id (the coordinator's scp equivalent);
+                # keys carry the launcher's run nonce so a stale/reused
+                # service cannot serve a previous run's strategy
+                ns = ENV.AUTODIST_RUN_ID.val
+                blob = base64.b64encode(str(s).encode()).decode()
+                self._coord.set('strategy/%s/blob' % ns, blob)
+                self._coord.set('strategy/%s/id' % ns, s.id)
         else:
             strategy_id = ENV.AUTODIST_STRATEGY_ID.val
-            assert strategy_id, \
-                'Worker process needs AUTODIST_STRATEGY_ID set'
-            s = strategy_base.Strategy.deserialize(strategy_id)
+            if strategy_id:
+                s = strategy_base.Strategy.deserialize(strategy_id)
+            elif self._coord is not None:
+                ns = ENV.AUTODIST_RUN_ID.val
+                self._coord.wait_key('strategy/%s/id' % ns,
+                                     timeout_s=120.0)
+                blob = self._coord.get('strategy/%s/blob' % ns)
+                d = json.loads(base64.b64decode(blob).decode())
+                s = strategy_base.Strategy.from_dict(d)
+            else:
+                raise RuntimeError(
+                    'Worker process needs AUTODIST_STRATEGY_ID set (or a '
+                    'coord service to fetch the strategy from)')
         return s
 
     def _compile_strategy(self, strategy):
@@ -119,33 +147,98 @@ class AutoDist:
         logging.info('Compiled strategy: %s', compiled)
         return compiled
 
+    @property
+    def _externally_launched(self):
+        """True when a launcher (launch_cli / pod runtime) already started
+        one process per host — the chief must not re-launch over ssh."""
+        return self._ext_launched
+
+    def _ensure_control_plane(self):
+        """Bring up / connect to the native coord service (multi-process
+        runs only). The chief starts it; every process gets a client."""
+        nodes = list(self._resource_spec.nodes)
+        multi = ENV.AUTODIST_NUM_PROCESSES.val > 1 or len(nodes) > 1
+        if not multi or self._coord is not None:
+            return
+        if IS_AUTODIST_CHIEF and not self._externally_launched:
+            # ssh-launch mode: claim identity before workers exist
+            os.environ.setdefault(ENV.AUTODIST_NUM_PROCESSES.name,
+                                  str(len(nodes)))
+            os.environ.setdefault(ENV.AUTODIST_PROCESS_ID.name, '0')
+        from autodist_tpu.runtime import coord_client
+        addr = ENV.AUTODIST_COORD_SERVICE_ADDR.val or \
+            '%s:%d' % (self._resource_spec.chief, DEFAULT_COORD_PORT)
+        host, port = addr.rsplit(':', 1)
+        if IS_AUTODIST_CHIEF:
+            from autodist_tpu.runtime.cluster import is_local_address
+            all_local = all(is_local_address(n) for n in nodes)
+            bind = '127.0.0.1' if all_local else '0.0.0.0'
+            self._coord_proc = coord_client.ensure_service(
+                int(port), bind=bind)
+            if self._coord_proc is not None and \
+                    not self._externally_launched:
+                # ssh-launch mode: the chief owns the service lifetime.
+                # Externally-launched runs (launch_cli / pod): the launcher
+                # outlives every process and shuts the service down — the
+                # chief may finish while workers still need it.
+                atexit.register(self._coord_proc.terminate)
+        self._coord = coord_client.connect_with_retry((host, int(port)))
+
+    @staticmethod
+    def _strategy_is_loose(strategy):
+        """True when every synchronizer is relaxed-consistency PS
+        (staleness>0 or sync=False): processes then run independent local
+        programs and meet only at the coord-service PS (the reference's
+        between-graph execution with accumulator num_required=1,
+        ps_synchronizer.py:387-458)."""
+        syncs = []
+        for node in strategy.node_config:
+            syncs.extend(node.part_config if node.part_config
+                         else [node.synchronizer])
+        ps = [s for s in syncs
+              if isinstance(s, strategy_base.PSSynchronizer)]
+        if len(ps) != len(syncs) or not ps:
+            return False
+        return all(s.staleness > 0 or not s.sync for s in ps)
+
     def _setup(self, strategy):
         """Chief-side cluster bring-up + worker launch (reference
         autodist.py:120-128).
 
         Order matters: workers must be launched BEFORE the blocking
         ``jax.distributed.initialize`` in ``cluster.start()`` — the
-        runtime only forms once the full quorum dials in. The chief also
-        claims its own identity (process 0 of len(nodes)) so start()
-        actually initializes multi-process mode."""
+        runtime only forms once the full quorum dials in."""
         nodes = list(self._resource_spec.nodes)
-        if IS_AUTODIST_CHIEF and len(nodes) > 1:
-            os.environ.setdefault(ENV.AUTODIST_NUM_PROCESSES.name,
-                                  str(len(nodes)))
-            os.environ.setdefault(ENV.AUTODIST_PROCESS_ID.name, '0')
+        if IS_AUTODIST_CHIEF and len(nodes) > 1 and \
+                not self._externally_launched:
             from autodist_tpu.runtime.coordinator import Coordinator
             self._coordinator = Coordinator(
                 strategy, self._resource_spec, self._cluster)
             self._coordinator.launch_clients()
             atexit.register(self._coordinator.terminate)
-        self._cluster.start()
 
     def _build(self):
+        self._ensure_control_plane()
         strategy = self._build_or_load_strategy()
         self._setup(strategy)
         compiled = self._compile_strategy(strategy)
-        mesh = mesh_from_strategy(compiled, self._resource_spec)
-        plan = ExecutionPlan(compiled, self._original_graph_item, mesh)
+        loose = ENV.AUTODIST_NUM_PROCESSES.val > 1 and \
+            self._strategy_is_loose(compiled)
+        if loose:
+            # relaxed-consistency PS: independent local programs + host PS;
+            # no global SPMD runtime to form
+            import jax
+            logging.info('Relaxed-consistency PS strategy: loose '
+                         'multi-process mode (local mesh + coord-service '
+                         'PS data plane)')
+            devices = jax.local_devices()
+        else:
+            self._cluster.start()
+            devices = None  # mesh_from_strategy uses the global view
+        mesh = mesh_from_strategy(compiled, self._resource_spec,
+                                  devices=devices)
+        plan = ExecutionPlan(compiled, self._original_graph_item, mesh,
+                             loose=loose)
         logging.info(plan.describe())
         self._transformed = (compiled, mesh, plan)
         self._built = True
@@ -160,7 +253,7 @@ class AutoDist:
             self._build()
         _, _, plan = self._transformed
         self._session = Session(self._original_graph_item, plan,
-                                cluster=self._cluster)
+                                cluster=self._cluster, coord=self._coord)
         atexit.register(self._session.close)
         return self._session
 

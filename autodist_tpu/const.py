@@ -22,7 +22,10 @@ DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, 'checkpoints')
 # Port range for the coordination service / distributed runtime
 # (reference uses 15000-16000 for tf.Server grpc ports, const.py:38).
 DEFAULT_PORT_RANGE = iter(range(15000, 16000))
-DEFAULT_COORD_PORT = 14999
+# jax.distributed coordinator and the native coord service are distinct
+# endpoints; keep their default ports distinct too.
+DEFAULT_JAX_COORD_PORT = 14999
+DEFAULT_COORD_PORT = 14998
 
 # Mesh axis names used by the strategy compiler. The reference only has a
 # replica ("data") dimension; the TPU rebuild exposes the full set.
@@ -61,6 +64,7 @@ class ENV(Enum):
     AUTODIST_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
     AUTODIST_COORDINATOR_ADDR = (lambda v: v if v else '',)          # host:port for jax.distributed
     AUTODIST_COORD_SERVICE_ADDR = (lambda v: v if v else '',)        # host:port for native coord service
+    AUTODIST_RUN_ID = (lambda v: v if v else '',)                    # launcher-issued run nonce (namespaces coord keys)
     AUTODIST_DUMP_GRAPHS = (lambda v: (v == 'True' or v == '1'),)    # dump jaxpr/HLO per phase
 
     @property

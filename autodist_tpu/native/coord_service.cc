@@ -10,6 +10,13 @@
 // ahead only while min_step >= my_step - staleness), heartbeats for
 // fail-fast monitoring, and small metadata exchange (strategy ids).
 //
+// The tensor commands (VSET/VGET/VADD) are the PS data plane: the
+// reference aggregates cross-worker gradients in ConditionalAccumulators
+// living on the PS task (ps_synchronizer.py:556-633); here workers push
+// float32 deltas with an atomic elementwise VADD into host memory —
+// commutative apply-per-push, which is exactly the reference's
+// staleness>0 accumulator mode (take_grad(1): every push is applied).
+//
 // Protocol: newline-terminated text commands over TCP.
 //   SET <key> <value>            -> OK
 //   GET <key>                    -> VAL <value> | NONE
@@ -19,6 +26,11 @@
 //   MINWAIT <prefix> <n> <k> <ms>-> VAL <min> | TIMEOUT
 //       (wait until >=k keys share <prefix> and their min value >= n)
 //   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
+//   VSET <key> <b64>             -> OK   (store float32 tensor bytes)
+//   VGET <key>                   -> VAL <b64> | NONE
+//   VADD <key> <b64>             -> VAL <n>  (atomic elementwise += ;
+//                                   creates the tensor if absent; returns
+//                                   the tensor's accumulated push count)
 //   PING                         -> PONG
 //   SHUTDOWN                     -> OK (server exits)
 //
@@ -51,10 +63,61 @@ struct Store {
   std::map<std::string, int64_t> counters;
   std::map<std::string, int64_t> barrier_arrivals;
   std::map<std::string, int64_t> barrier_generation;
+  std::map<std::string, std::vector<float>> tensors;
+  std::map<std::string, int64_t> tensor_pushes;
   std::atomic<bool> shutting_down{false};
 };
 
 Store g_store;
+
+// -- base64 (payloads for the tensor commands) ------------------------------
+
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64_encode(const unsigned char* data, size_t len) {
+  std::string out;
+  out.reserve((len + 2) / 3 * 4);
+  for (size_t i = 0; i < len; i += 3) {
+    uint32_t v = data[i] << 16;
+    if (i + 1 < len) v |= data[i + 1] << 8;
+    if (i + 2 < len) v |= data[i + 2];
+    out.push_back(kB64[(v >> 18) & 63]);
+    out.push_back(kB64[(v >> 12) & 63]);
+    out.push_back(i + 1 < len ? kB64[(v >> 6) & 63] : '=');
+    out.push_back(i + 2 < len ? kB64[v & 63] : '=');
+  }
+  return out;
+}
+
+struct B64Rev {
+  int rev[256];
+  B64Rev() {
+    for (int i = 0; i < 256; ++i) rev[i] = -1;
+    for (int i = 0; i < 64; ++i) rev[static_cast<int>(kB64[i])] = i;
+  }
+};
+// initialized before main(): connection threads share it read-only
+const B64Rev g_b64rev;
+
+bool b64_decode(const std::string& in, std::vector<unsigned char>* out) {
+  const int* rev = g_b64rev.rev;
+  out->clear();
+  uint32_t v = 0;
+  int bits = 0;
+  for (char c : in) {
+    if (c == '=') break;
+    int d = rev[static_cast<unsigned char>(c)];
+    if (d < 0) return false;
+    v = (v << 6) | d;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back((v >> bits) & 0xff);
+    }
+  }
+  return true;
+}
 
 int64_t counter_of(const std::string& key) {
   auto it = g_store.counters.find(key);
@@ -158,7 +221,60 @@ std::string handle(const std::string& line) {
       return g_store.barrier_generation[name] != gen ||
              g_store.shutting_down;
     });
-    return (ok && !g_store.shutting_down) ? "OK" : "TIMEOUT";
+    if (ok && !g_store.shutting_down) return "OK";
+    // Withdraw this party's arrival so a timeout doesn't poison the
+    // barrier name: a later round must still need k live arrivals. Only
+    // if the round we joined never completed (generation unchanged).
+    if (g_store.barrier_generation[name] == gen &&
+        g_store.barrier_arrivals[name] > 0) {
+      --g_store.barrier_arrivals[name];
+    }
+    return "TIMEOUT";
+  }
+  if (cmd == "VSET") {
+    std::string k, b64;
+    in >> k >> b64;
+    std::vector<unsigned char> bytes;
+    if (!b64_decode(b64, &bytes) || bytes.size() % sizeof(float) != 0)
+      return "ERR bad payload";
+    std::lock_guard<std::mutex> l(g_store.mu);
+    std::vector<float>& t = g_store.tensors[k];
+    t.assign(bytes.size() / sizeof(float), 0.f);
+    memcpy(t.data(), bytes.data(), bytes.size());
+    g_store.tensor_pushes[k] = 0;
+    g_store.cv.notify_all();
+    return "OK";
+  }
+  if (cmd == "VGET") {
+    std::string k;
+    in >> k;
+    std::vector<float> snapshot;
+    {
+      std::lock_guard<std::mutex> l(g_store.mu);
+      auto it = g_store.tensors.find(k);
+      if (it == g_store.tensors.end()) return "NONE";
+      snapshot = it->second;  // copy under lock, encode outside it
+    }
+    return "VAL " + b64_encode(
+        reinterpret_cast<const unsigned char*>(snapshot.data()),
+        snapshot.size() * sizeof(float));
+  }
+  if (cmd == "VADD") {
+    std::string k, b64;
+    in >> k >> b64;
+    std::vector<unsigned char> bytes;
+    if (!b64_decode(b64, &bytes) || bytes.size() % sizeof(float) != 0)
+      return "ERR bad payload";
+    size_t n = bytes.size() / sizeof(float);
+    const float* delta = reinterpret_cast<const float*>(bytes.data());
+    std::lock_guard<std::mutex> l(g_store.mu);
+    std::vector<float>& t = g_store.tensors[k];
+    if (t.empty()) t.assign(n, 0.f);
+    if (t.size() != n) return "ERR shape mismatch";
+    for (size_t i = 0; i < n; ++i) t[i] += delta[i];
+    int64_t pushes = ++g_store.tensor_pushes[k];
+    g_store.cv.notify_all();
+    return "VAL " + std::to_string(pushes);
   }
   if (cmd == "SHUTDOWN") {
     std::lock_guard<std::mutex> l(g_store.mu);
@@ -198,13 +314,16 @@ void serve_conn(int fd) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  int port = argc > 1 ? atoi(argv[1]) : 14999;
+  int port = argc > 1 ? atoi(argv[1]) : 14998;
+  // Bind address: second arg; loopback unless the launcher asks for more
+  // (multi-host runs pass 0.0.0.0 or the coordinator interface).
+  const char* bind_addr = argc > 2 ? argv[2] : "127.0.0.1";
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_addr.s_addr = inet_addr(bind_addr);
   addr.sin_port = htons(port);
   if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     perror("bind");

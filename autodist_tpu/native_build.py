@@ -1,9 +1,10 @@
 """On-demand builder for the framework's native (C++) components.
 
-Sources live in ``native/``; binaries/libraries are cached under
+Sources live in ``autodist_tpu/native/`` (inside the package so installed
+wheels ship them); binaries/libraries are cached under
 ``/tmp/autodist-tpu/native/<source-hash>/`` so rebuilds happen only when
 the source changes. Uses plain g++ (present in the supported images); a
-``make``-based flow is equivalent (see native/Makefile).
+``make``-based flow is equivalent (see autodist_tpu/native/Makefile).
 """
 import hashlib
 import os
@@ -12,8 +13,8 @@ import subprocess
 from autodist_tpu.const import DEFAULT_WORKING_DIR
 from autodist_tpu.utils import logging
 
-NATIVE_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), 'native')
+NATIVE_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'native')
 NATIVE_CACHE_DIR = os.path.join(DEFAULT_WORKING_DIR, 'native')
 
 

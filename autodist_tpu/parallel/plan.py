@@ -100,11 +100,21 @@ class VarPlan:
 class ExecutionPlan:
     """Binds (strategy, graph_item, mesh) into callable sync/sharding hooks."""
 
-    def __init__(self, strategy, graph_item, mesh, shard_ps_state=True):
+    def __init__(self, strategy, graph_item, mesh, shard_ps_state=True,
+                 loose=False):
         self.strategy = strategy
         self.graph_item = graph_item
         self.mesh = mesh
         self.num_replicas = mesh.shape[AXIS_DATA]
+        # loose mode: independent per-process programs + coord-service PS
+        # (relaxed-consistency strategies); mesh is process-local.
+        self.loose = loose
+        # how many jax processes share this mesh (global SPMD mode); the
+        # feed/fetch contract is process-local (between-graph semantics)
+        self.num_processes = 1 if loose else \
+            max(1, len({d.process_index for d in mesh.devices.flat}))
+        self.local_replicas = max(1, self.num_replicas //
+                                  self.num_processes)
         self.var_plans = {}
         nodes = {n.var_name: n for n in strategy.node_config}
         for name, var in graph_item.trainable_var_op_to_var.items():
@@ -125,13 +135,22 @@ class ExecutionPlan:
             self.var_plans[name] = plan
         self.max_staleness = max(
             [p.staleness for p in self.var_plans.values()] + [0])
+        self.sync_mode = all(p.sync_mode for p in self.var_plans.values())
+        # loose-mode gate: any sync=True var demands its staleness bound;
+        # the program-wide gate enforces the tightest one (per-variable
+        # windows collapse to one window since the step is one program).
+        sync_stale = [p.staleness for p in self.var_plans.values()
+                      if p.sync_mode]
+        self.gate_enabled = bool(sync_stale)
+        self.gate_staleness = min(sync_stale) if sync_stale else 0
         relaxed = [p for p in self.var_plans.values()
                    if p.staleness > 0 or not p.sync_mode]
-        if relaxed:
+        if relaxed and not loose:
             # Within one SPMD program all replicas are lock-step, which
             # trivially satisfies any staleness bound; the relaxed-
             # consistency fast path (multi-process async PS over the
-            # coordination service) only engages in multi-process runs.
+            # coordination service) only engages in multi-process runs
+            # with an all-relaxed-PS strategy.
             logging.warning(
                 'Strategy requests relaxed consistency (async/stale) for '
                 '%d vars; single-program execution is synchronous, which '
@@ -232,8 +251,10 @@ class ExecutionPlan:
             if shape is not None and (len(shape) == 0 or
                                       shape[0] is not None):
                 return False
+        # Feeds are process-local (between-graph semantics): the value only
+        # has to split across this process's local replicas.
         return (getattr(value, 'ndim', 0) >= 1 and
-                value.shape[0] % self.num_replicas == 0 and
+                value.shape[0] % self.local_replicas == 0 and
                 value.shape[0] > 0)
 
     def describe(self):

@@ -15,7 +15,7 @@ import socket
 
 import jax
 
-from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
+from autodist_tpu.const import DEFAULT_JAX_COORD_PORT, ENV
 from autodist_tpu.utils import logging
 
 
@@ -77,8 +77,18 @@ class Cluster:
             coord = (ENV.AUTODIST_COORDINATOR_ADDR.val or
                      self._resource_spec.coordinator_address or
                      '%s:%d' % (self._resource_spec.chief,
-                                DEFAULT_COORD_PORT))
+                                DEFAULT_JAX_COORD_PORT))
             pid = ENV.AUTODIST_PROCESS_ID.val
+            try:
+                # CPU backends need an explicit cross-process collectives
+                # implementation (TPU ICI needs none). Must be set before
+                # the backend initializes; harmless otherwise.
+                jax.config.update('jax_cpu_collectives_implementation',
+                                  'gloo')
+            except Exception:   # noqa: BLE001 - older jaxlib w/o gloo
+                logging.warning('CPU collectives backend unavailable; '
+                                'multi-process CPU runs will not form a '
+                                'global mesh')
             logging.info('jax.distributed.initialize(%s, %d, %d)',
                          coord, num_procs, pid)
             jax.distributed.initialize(

@@ -13,16 +13,23 @@ the c9 timing contract): each worker publishes its step counter under
 staleness``. A fast worker can thus run at most ``staleness`` steps ahead
 — the queue-capacity semantics without TF FIFO queues.
 """
+import base64
 import socket
 import subprocess
 import time
+
+import numpy as np
 
 from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
 from autodist_tpu.utils import logging
 
 
-def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0):
-    """Start the native service on this host if nothing is listening."""
+def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
+    """Start the native service on this host if nothing is listening.
+
+    Binds loopback by default; multi-host launchers pass ``bind='0.0.0.0'``
+    (or the coordinator interface) explicitly.
+    """
     try:
         CoordClient(('127.0.0.1', port), timeout=0.5).ping()
         return None  # already running
@@ -30,7 +37,7 @@ def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0):
         pass
     from autodist_tpu.native_build import build
     binary = build('coord_service.cc')
-    proc = subprocess.Popen([binary, str(port)],
+    proc = subprocess.Popen([binary, str(port), bind],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     deadline = time.time() + wait_s
@@ -43,6 +50,23 @@ def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0):
         except OSError:
             time.sleep(0.05)
     raise RuntimeError('coord_service failed to start on :%d' % port)
+
+
+def connect_with_retry(address=None, deadline_s=30.0):
+    """Connect to the coord service, retrying until it comes up (workers
+    may start before the chief's ensure_service)."""
+    deadline = time.time() + deadline_s
+    last = None
+    while time.time() < deadline:
+        try:
+            c = CoordClient(address, timeout=5.0)
+            c.ping()
+            return c
+        except OSError as e:
+            last = e
+            time.sleep(0.1)
+    raise RuntimeError('coord_service unreachable at %s: %s'
+                       % (address, last))
 
 
 class CoordClient:
@@ -71,7 +95,10 @@ class CoordClient:
 
     # -- primitives --------------------------------------------------------
     def ping(self):
-        assert self._rpc('PING') == 'PONG'
+        resp = self._rpc('PING')
+        if resp != 'PONG':
+            # whatever is on this port, it is not a coord service
+            raise OSError('unexpected PING reply %r' % resp[:64])
 
     def set(self, key, value):
         assert self._rpc('SET %s %s' % (key, value)) == 'OK'
@@ -116,22 +143,64 @@ class CoordClient:
         except OSError:
             pass
 
+    # -- tensor data plane (PS accumulator equivalent) ---------------------
+    def vset(self, key, value):
+        """Store a float32 tensor (authoritative PS copy)."""
+        arr = np.ascontiguousarray(np.asarray(value, dtype=np.float32))
+        payload = base64.b64encode(arr.tobytes()).decode()
+        resp = self._rpc('VSET %s %s' % (key, payload))
+        if resp != 'OK':
+            raise OSError('VSET %s failed: %s' % (key, resp))
+
+    def vget(self, key, shape=None, dtype=np.float32):
+        """Fetch a float32 tensor, or None if absent."""
+        resp = self._rpc('VGET %s' % key)
+        if resp == 'NONE':
+            return None
+        arr = np.frombuffer(base64.b64decode(resp[4:]), dtype=np.float32)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return arr.astype(dtype, copy=False)
+
+    def vadd(self, key, delta):
+        """Atomically add a float32 delta elementwise (apply-per-push,
+        the reference's staleness-mode ConditionalAccumulator semantics,
+        ps_synchronizer.py:556-633 with num_required=1). Returns the
+        tensor's total push count."""
+        arr = np.ascontiguousarray(np.asarray(delta, dtype=np.float32))
+        payload = base64.b64encode(arr.tobytes()).decode()
+        resp = self._rpc('VADD %s %s' % (key, payload))
+        if not resp.startswith('VAL'):
+            raise OSError('VADD %s failed: %s' % (key, resp))
+        return int(resp[4:])
+
+    def wait_key(self, key, timeout_s=60.0, poll_s=0.05):
+        """Poll-wait for a KV key to appear; returns its value."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(poll_s)
+        raise TimeoutError('wait_key(%s)' % key)
+
     def close(self):
         self._sock.close()
 
     # -- composite: bounded staleness -------------------------------------
-    def publish_step(self, worker, step):
+    def publish_step(self, worker, step, prefix='step/'):
         """Publish this worker's completed-step counter."""
-        cur = self.incr('step/%s' % worker, 0)
+        key = prefix + worker
+        cur = self.incr(key, 0)
         if step > cur:
-            self.incr('step/%s' % worker, step - cur)
+            self.incr(key, step - cur)
 
     def staleness_gate(self, step, staleness, num_workers,
-                       timeout_s=600.0):
+                       timeout_s=600.0, prefix='step/'):
         """Block until every worker is within ``staleness`` steps."""
         if step <= staleness:
             return
-        self.min_wait('step/', step - staleness, num_workers, timeout_s)
+        self.min_wait(prefix, step - staleness, num_workers, timeout_s)
 
     # -- composite: heartbeat / failure detection --------------------------
     def heartbeat(self, worker):

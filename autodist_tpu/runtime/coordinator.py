@@ -18,10 +18,12 @@ import subprocess
 import sys
 import threading
 
-from autodist_tpu.const import DEFAULT_WORKING_DIR, ENV
+from autodist_tpu.const import (DEFAULT_COORD_PORT, DEFAULT_JAX_COORD_PORT,
+                                DEFAULT_WORKING_DIR, ENV)
 from autodist_tpu.utils import logging
 
 _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
+                    ENV.AUTODIST_COORD_SERVICE_ADDR,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 
@@ -46,7 +48,12 @@ class Coordinator:
                 str(len(list(self._resource_spec.nodes))),
             ENV.AUTODIST_COORDINATOR_ADDR.name:
                 ENV.AUTODIST_COORDINATOR_ADDR.val or
-                ('%s:%d' % (self._resource_spec.chief, 14999)),
+                ('%s:%d' % (self._resource_spec.chief,
+                            DEFAULT_JAX_COORD_PORT)),
+            ENV.AUTODIST_COORD_SERVICE_ADDR.name:
+                ENV.AUTODIST_COORD_SERVICE_ADDR.val or
+                ('%s:%d' % (self._resource_spec.chief,
+                            DEFAULT_COORD_PORT)),
         }
         for flag in _FORWARDED_FLAGS:
             raw = os.environ.get(flag.name)
@@ -143,19 +150,34 @@ def launch_cli(argv=None):
     parser = argparse.ArgumentParser(prog='autodist_tpu.launch')
     parser.add_argument('--spec', help='resource spec YAML',
                         default=ENV.SYS_RESOURCE_PATH.val or None)
-    parser.add_argument('--coordinator-port', type=int, default=14999)
+    parser.add_argument('--coordinator-port', type=int,
+                        default=DEFAULT_JAX_COORD_PORT)
     parser.add_argument('script')
     parser.add_argument('args', nargs=argparse.REMAINDER)
     ns = parser.parse_args(argv)
 
     from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import is_local_address
     spec = ResourceSpec(resource_file=ns.spec) if ns.spec else None
     nodes = list(spec.nodes) if spec else ['localhost']
     chief = spec.chief if spec else 'localhost'
     nodes = [chief] + [n for n in nodes if n != chief]
     coord = '%s:%d' % (chief, ns.coordinator_port)
+    coord_service = ENV.AUTODIST_COORD_SERVICE_ADDR.val or \
+        '%s:%d' % (chief, DEFAULT_COORD_PORT)
 
     os.makedirs(DEFAULT_WORKING_DIR, exist_ok=True)
+    # The launcher owns the coord service: it must outlive every process
+    # (a fast chief may finish while slow workers still push PS deltas).
+    service_proc = None
+    cs_host, cs_port = coord_service.rsplit(':', 1)
+    if is_local_address(cs_host):
+        from autodist_tpu.runtime import coord_client
+        all_local = all(is_local_address(n) for n in nodes)
+        service_proc = coord_client.ensure_service(
+            int(cs_port), bind='127.0.0.1' if all_local else '0.0.0.0')
+    import uuid
+    run_id = uuid.uuid4().hex[:12]
     procs = []
     for i, address in enumerate(nodes):
         env = dict(os.environ)
@@ -163,11 +185,14 @@ def launch_cli(argv=None):
             ENV.AUTODIST_PROCESS_ID.name: str(i),
             ENV.AUTODIST_NUM_PROCESSES.name: str(len(nodes)),
             ENV.AUTODIST_COORDINATOR_ADDR.name: coord,
+            ENV.AUTODIST_COORD_SERVICE_ADDR.name: coord_service,
+            ENV.AUTODIST_RUN_ID.name: run_id,
         })
         if i > 0:
             env[ENV.AUTODIST_WORKER.name] = address
         cmd = [sys.executable, ns.script] + ns.args
-        if address in ('localhost', '127.0.0.1', chief) and i == 0:
+        if is_local_address(address):
+            # same-host process (multi-process-per-host and test tiers)
             procs.append(subprocess.Popen(cmd, env=env))
         else:
             ssh_config = spec.ssh_config(address) if spec else None
@@ -191,4 +216,6 @@ def launch_cli(argv=None):
     rc = 0
     for p in procs:
         rc = p.wait() or rc
+    if service_proc is not None:
+        service_proc.terminate()
     return rc

@@ -48,16 +48,40 @@ class RunOptions:
 
 
 class Session:
-    """Stateful driver over the functional compiled step."""
+    """Stateful driver over the functional compiled step.
 
-    def __init__(self, graph_item, plan, cluster=None):
+    Multi-process modes:
+
+    - **global SPMD** (sync strategies): every process joins one program
+      over a multi-host mesh; gradient sync rides XLA collectives. The
+      feed/fetch contract stays process-local (between-graph semantics:
+      each worker feeds its own batch, fetches its own replicas' values).
+    - **loose** (all-relaxed PS strategies): each process runs an
+      independent local program; variables are authoritative on the native
+      coord service, workers pull values / push update deltas every step
+      (apply-per-push = reference staleness-mode accumulators,
+      ps_synchronizer.py:387-458) gated by the bounded-staleness window.
+    """
+
+    def __init__(self, graph_item, plan, cluster=None, coord=None):
         self._graph_item = graph_item
         self._plan = plan
         self._mesh = plan.mesh
         self._cluster = cluster
+        self._coord = coord
         self._cache = {}
         self._step_count = 0
         self._closed = False
+        self._loose = plan.loose
+        self._num_workers = ENV.AUTODIST_NUM_PROCESSES.val
+        self._worker_name = 'p%d' % ENV.AUTODIST_PROCESS_ID.val
+        self._is_chief = not ENV.AUTODIST_WORKER.val
+        if self._loose and coord is None:
+            raise RuntimeError('loose multi-process mode needs a coord '
+                               'service client')
+        # namespace coord-service keys by strategy id: a reused/leaked
+        # service must not serve a previous run's vars or step counters
+        self._ns = getattr(plan.strategy, 'id', 'default')
         # graph-mutation guard (reference autodist.py:152-165): the
         # captured program must not grow after the session is built.
         # VariableRead nodes are excluded: they are framework-internal and
@@ -69,12 +93,79 @@ class Session:
         return sum(1 for n in self._graph_item.graph.nodes
                    if not isinstance(n, fe.VariableRead))
 
+    def _key(self, suffix):
+        return '%s/%s' % (self._ns, suffix)
+
+    def peer_step(self, process_id):
+        """Another worker's published completed-step counter (0 if none)."""
+        return self._coord.incr(self._key('step/') + 'p%d' % process_id, 0)
+
+    # -- multi-process placement helpers ----------------------------------
+    def _put(self, value, sharding):
+        """Place a host value that is logically global (same on every
+        process): works for replicated and sharded NamedShardings."""
+        if self._plan.num_processes == 1:
+            return jax.device_put(jnp.asarray(value), sharding)
+        val = np.asarray(value)
+        return jax.make_array_from_callback(
+            val.shape, sharding, lambda idx: val[idx])
+
+    def _put_feed(self, value, spec):
+        """Place a process-local feed: under multi-process SPMD the value
+        is this worker's chunk of the global batch (reference between-graph
+        feeds, remapper.py:109-123)."""
+        sharding = NamedSharding(self._mesh, spec)
+        if self._plan.num_processes == 1:
+            return jax.device_put(jnp.asarray(value), sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(value))
+
+    def _local_stack(self, arr):
+        """This process's replicas of a P(data)-stacked output.
+
+        Dedup by data-axis offset: on a multi-axis mesh a device holds one
+        addressable shard per (data × other-axes) tile, but replicas across
+        non-data axes carry the same data rows."""
+        if self._plan.num_processes == 1:
+            return np.asarray(arr)
+        by_offset = {}
+        for s in arr.addressable_shards:
+            by_offset.setdefault(s.index[0].start or 0, s)
+        shards = [by_offset[k] for k in sorted(by_offset)]
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
     # -- state ------------------------------------------------------------
     def _init_state(self):
         plan = self._plan
+        if plan.num_processes > 1:
+            # replicas must start from the chief's initial values
+            # (reference shares initializers: all_reduce_synchronizer.py:
+            # 175-196); broadcast before placing.
+            from jax.experimental import multihost_utils
+            names = sorted(self._graph_item.graph.variables)
+            vals = [np.asarray(
+                self._graph_item.graph.variables[n].init_value)
+                for n in names]
+            vals = multihost_utils.broadcast_one_to_all(vals)
+            for n, v in zip(names, vals):
+                self._graph_item.graph.variables[n].init_value = \
+                    np.asarray(v)
+        if self._loose:
+            # chief seeds the authoritative PS copies on the coord service
+            if self._is_chief:
+                for name, var in self._graph_item.graph.variables.items():
+                    self._coord.vset(self._key('var/%s' % name),
+                                     np.asarray(var.init_value))
+            self._coord.barrier(self._key('session/init'),
+                                self._num_workers, timeout_s=120.0)
+            if not self._is_chief:
+                for name, var in self._graph_item.graph.variables.items():
+                    served = self._coord.vget(self._key('var/%s' % name),
+                                              shape=var.shape)
+                    var.init_value = served.astype(var.init_value.dtype)
         self._var_state = {}
         for name, var in self._graph_item.graph.variables.items():
-            self._var_state[name] = jax.device_put(
+            self._var_state[name] = self._put(
                 jnp.asarray(var.init_value), plan.var_sharding(name))
         # per-optimizer slot state {uid: {var name: optax leaf state}};
         # one optimizer may appear in several ApplyGradients nodes — merge
@@ -95,6 +186,10 @@ class Session:
             self._opt_state[uid] = {
                 vname: self._place_slots(vname, leafstate)
                 for vname, leafstate in slots.items()}
+        # NB: in loose mode optimizer slots are worker-local by design —
+        # the reference shares slots on the PS, but concurrent slot updates
+        # under relaxed consistency are racy there too; device-local slots
+        # are the TPU-native choice.
         # compressor/aux state. These leaves are *per-replica* (e.g. each
         # device's error-feedback residual differs), so they carry an
         # explicit leading replica dimension sharded over the data axis.
@@ -106,7 +201,7 @@ class Session:
                 np.asarray(vplan.var.init_value))
             if aux:
                 self._aux_state['compressor/%s' % name] = {
-                    k: jax.device_put(
+                    k: self._put(
                         jnp.broadcast_to(jnp.asarray(v),
                                          (n,) + tuple(v.shape)),
                         rep_sharding)
@@ -122,8 +217,8 @@ class Session:
         def place(leaf):
             if hasattr(leaf, 'shape') and tuple(leaf.shape) == \
                     tuple(var.shape):
-                return jax.device_put(jnp.asarray(leaf), sharding)
-            return jax.device_put(jnp.asarray(leaf), repl)
+                return self._put(jnp.asarray(leaf), sharding)
+            return self._put(jnp.asarray(leaf), repl)
 
         return jax.tree.map(place, leafstate)
 
@@ -168,11 +263,23 @@ class Session:
                                                 split_flags)
         fn = self._cache[key]
 
+        pulled = None
+        if self._loose:
+            # bounded-staleness window (reference token queues of size s,
+            # ps_synchronizer.py:387-458): before running step s (1-based)
+            # every worker must have completed >= s - staleness steps.
+            # sync=False vars are unconditional no-wait (ps_strategy.py:
+            # 30-35); any sync var imposes its (tightest) bound.
+            if self._plan.gate_enabled:
+                self._coord.staleness_gate(
+                    self._step_count + 1, self._plan.gate_staleness,
+                    self._num_workers, prefix=self._key('step/'))
+            pulled = self._pull_ps_vars()
+
         placed = []
         for v, split in zip(feed_vals, split_flags):
-            spec = P(AXIS_DATA) if split else P()
-            placed.append(jax.device_put(
-                jnp.asarray(v), NamedSharding(self._mesh, spec)))
+            placed.append(self._put_feed(v, P(AXIS_DATA) if split
+                                         else P()))
 
         tracing = options is not None and \
             getattr(options, 'trace_level', 0) > 0
@@ -190,21 +297,52 @@ class Session:
                 logging.info('Profiler trace written to %s',
                              options.trace_dir)
         self._step_count += 1
+        if self._loose:
+            self._push_ps_deltas(pulled)
+            self._coord.publish_step(self._worker_name, self._step_count,
+                                     prefix=self._key('step/'))
 
-        split_sizes = {v.shape[0] // self._plan.num_replicas
+        split_sizes = {v.shape[0] // self._plan.local_replicas
                        for v, s in zip(feed_vals, split_flags) if s}
         results = [self._contract(f, o, split_sizes)
                    for f, o in zip(norm, outs)]
         return results[0] if single else results
+
+    # -- loose-mode PS data plane -----------------------------------------
+    def _pull_ps_vars(self):
+        """Refresh variable state from the authoritative coord-service
+        copies (the worker's per-step PS read). Returns the pulled host
+        values for delta computation."""
+        pulled = {}
+        for name, var in self._graph_item.graph.variables.items():
+            served = self._coord.vget(self._key('var/%s' % name),
+                                      shape=var.shape)
+            if served is None:   # pragma: no cover - init barrier ensures
+                served = np.asarray(var.init_value, dtype=np.float32)
+            served = served.astype(var.init_value.dtype)
+            pulled[name] = served
+            self._var_state[name] = self._put(
+                jnp.asarray(served), self._plan.var_sharding(name))
+        return pulled
+
+    def _push_ps_deltas(self, pulled):
+        """Push ``new - pulled`` per variable: VADD is commutative, so
+        concurrent workers' updates accumulate exactly like the
+        reference's apply-per-push accumulators."""
+        for name, before in pulled.items():
+            after = self._local_value(name)
+            delta = np.asarray(after, dtype=np.float32) - \
+                np.asarray(before, dtype=np.float32)
+            self._coord.vadd(self._key('var/%s' % name), delta)
 
     def _contract(self, fetch, stacked, split_sizes):
         """Apply the reference fetch contract to the per-replica stack."""
         if isinstance(fetch, fe.ApplyGradients):
             return None
         if isinstance(stacked, list):  # list-valued fetch (Gradients)
-            return [np.asarray(s)[0] for s in stacked]
-        val = np.asarray(stacked)
-        n = self._plan.num_replicas
+            return [self._local_stack(s)[0] for s in stacked]
+        val = self._local_stack(stacked)
+        n = self._plan.local_replicas
         local = val[0]
         # Polymorphic-dim rule (remapper.py:125-185): feeds were split and
         # the fetch still carries a per-example leading dim -> concatenate
@@ -307,11 +445,32 @@ class Session:
         return self._step_count
 
     # state access for savers / tests
+    def _local_value(self, name):
+        arr = self._var_state[name]
+        if getattr(arr, 'is_fully_addressable', True):
+            return np.asarray(arr)
+        sharding = getattr(arr, 'sharding', None)
+        if sharding is not None and sharding.is_fully_replicated:
+            return np.asarray(arr.addressable_shards[0].data)
+        # cross-process sharded state: gather (collective — every process
+        # must make this call)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            arr, tiled=True))
+
     def get_variable_value(self, var):
         name = var.name if isinstance(var, fe.Variable) else var
-        return np.asarray(self._var_state[name])
+        if self._loose:
+            # authoritative copy lives on the coord-service PS
+            var_obj = self._graph_item.var_by_name(name)
+            served = self._coord.vget(self._key('var/%s' % name),
+                                      shape=var_obj.shape)
+            return served.astype(var_obj.init_value.dtype)
+        return self._local_value(name)
 
     def load_variable_value(self, var, value):
         name = var.name if isinstance(var, fe.Variable) else var
-        self._var_state[name] = jax.device_put(
+        self._var_state[name] = self._put(
             jnp.asarray(value), self._plan.var_sharding(name))
+        if self._loose and self._is_chief:
+            self._coord.vset(self._key('var/%s' % name), np.asarray(value))
