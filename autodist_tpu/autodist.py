@@ -166,11 +166,11 @@ class AutoDist:
                                   str(len(nodes)))
             os.environ.setdefault(ENV.AUTODIST_PROCESS_ID.name, '0')
         from autodist_tpu.runtime import coord_client
+        from autodist_tpu.runtime.cluster import is_local_address
         addr = ENV.AUTODIST_COORD_SERVICE_ADDR.val or \
             '%s:%d' % (self._resource_spec.chief, DEFAULT_COORD_PORT)
         host, port = addr.rsplit(':', 1)
-        if IS_AUTODIST_CHIEF:
-            from autodist_tpu.runtime.cluster import is_local_address
+        if IS_AUTODIST_CHIEF and is_local_address(host):
             all_local = all(is_local_address(n) for n in nodes)
             bind = '127.0.0.1' if all_local else '0.0.0.0'
             self._coord_proc = coord_client.ensure_service(
@@ -179,10 +179,24 @@ class AutoDist:
                     not self._externally_launched:
                 # ssh-launch mode: the chief owns the service lifetime.
                 # Externally-launched runs (launch_cli / pod): the launcher
-                # outlives every process and shuts the service down — the
-                # chief may finish while workers still need it.
+                # (or the next run, which reuses a still-listening service)
+                # owns it — the chief may finish while workers still need
+                # it, so it must not tear it down here.
                 atexit.register(self._coord_proc.terminate)
         self._coord = coord_client.connect_with_retry((host, int(port)))
+        if self._externally_launched:
+            # All processes started together: clear any stale strategy
+            # keys a reused service may hold BEFORE anyone waits on them.
+            # The barrier guarantees no worker reads until the chief's
+            # deletes have landed. (ssh mode skips this: workers are
+            # launched later, with the strategy id in their env.)
+            ns = ENV.AUTODIST_RUN_ID.val
+            if IS_AUTODIST_CHIEF:
+                self._coord.delete('strategy/%s/id' % ns)
+                self._coord.delete('strategy/%s/blob' % ns)
+            self._coord.barrier('ctrl/init/%s' % ns,
+                                ENV.AUTODIST_NUM_PROCESSES.val,
+                                timeout_s=120.0)
 
     @staticmethod
     def _strategy_is_loose(strategy):

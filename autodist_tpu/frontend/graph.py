@@ -247,6 +247,15 @@ class Variable:
         # analogue of the reference's IndexedSlices-gradient detection
         # (partitioned_ps_strategy.py / parallax_strategy.py sparse checks).
         self.sparse_read = False
+        # The id-tensor nodes of those lookups: lets the sync layer ship
+        # (indices, rows) instead of the dense vocab-sized gradient (the
+        # IndexedSlices equivalent, reference partitioner.py:660-684).
+        # lookup_ops are the gather Op nodes themselves, used to prove the
+        # variable has no OTHER (dense) consumers before the sparse wire
+        # is allowed — a dense use contributes gradient to rows outside
+        # the looked-up set, which the sparse wire would drop.
+        self.lookup_ids = []
+        self.lookup_ops = []
         self.graph.register_variable(self)
         self._read = None
 

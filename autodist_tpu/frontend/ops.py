@@ -148,10 +148,14 @@ def gather(params, indices, axis=0):
     """Index gather; marks a Variable source as sparse-read so strategy
     builders can treat its gradient as sparse (reference: IndexedSlices
     through ``embedding_lookup_v2``, partitioner.py:576-602)."""
+    node = _sym(lambda p, i: jnp.take(p, i.astype(jnp.int32), axis=axis),
+                params, indices)
     if isinstance(params, fe.Variable):
         params.sparse_read = True
-    return _sym(lambda p, i: jnp.take(p, i.astype(jnp.int32), axis=axis),
-                params, indices)
+        if axis == 0 and isinstance(indices, fe.SymTensor):
+            params.lookup_ids.append(indices)
+            params.lookup_ops.append(node)
+    return node
 
 
 def embedding_lookup(params, ids):

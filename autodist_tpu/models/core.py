@@ -21,7 +21,43 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from autodist_tpu.parallel.axes import constrain, live_mesh_axis
+from autodist_tpu.parallel.axes import (constrain, current_mesh,
+                                        live_mesh_axis, manual_axis)
+
+
+def sharded_embedding_lookup(table, ids, axis):
+    """Row gather from a table sharded along dim 0 over mesh axis ``axis``.
+
+    Each shard takes the rows it owns (out-of-range rows fill with 0) and
+    a psum over the axis assembles full rows: comm is O(batch*dim), vs the
+    O(batch*vocab) one-hot matmul. Works both inside an already-manual
+    region (explicit collectives) and under GSPMD (wrapped in a
+    partial-manual shard_map over just the vocab axis)."""
+    def masked(shard, ids_):
+        size = shard.shape[0]
+        local = ids_ - jax.lax.axis_index(axis) * size
+        # negative indices would wrap (numpy semantics); send them out of
+        # bounds high so mode='fill' zeroes them
+        local = jnp.where(local >= 0, local, size)
+        rows = jnp.take(shard, local, axis=0, mode='fill', fill_value=0)
+        return jax.lax.psum(rows, axis)
+
+    if manual_axis(axis):
+        return masked(table, ids)
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    if ctx_mesh.shape:
+        # already inside a manual region where the vocab axis stays auto:
+        # shardy rejects a nested shard_map re-entering those axes, so
+        # fall back to the one-hot matmul (partitions cleanly under GSPMD
+        # and runs on the MXU).
+        vocab = table.shape[0]
+        oh = jax.nn.one_hot(ids, vocab, dtype=table.dtype)
+        return oh @ table
+    from jax.sharding import PartitionSpec as P
+    return jax.shard_map(
+        masked, mesh=current_mesh(), axis_names={axis},
+        in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=False)(table, ids)
 
 
 @dataclass
@@ -127,12 +163,15 @@ class Embedding(Module):
 
     def apply(self, params, ids):
         table = params['table'].astype(self.dtype)
-        if live_mesh_axis(self.vocab_axis) is not None:
-            # Tensor-sharded table: one-hot matmul instead of gather —
-            # partitions cleanly (each shard contributes its slice via a
-            # plain dot) and runs on the MXU.
-            oh = jax.nn.one_hot(ids, self.vocab, dtype=self.dtype)
-            return oh @ table
+        axis = live_mesh_axis(self.vocab_axis)
+        if axis is not None:
+            # Vocab-sharded table: masked local gather + psum, O(B*dim)
+            # comm instead of the O(B*vocab) one-hot matmul (the sharded
+            # analogue of the reference's embedding_lookup_v2 over
+            # partitioned vars, partitioner.py:576-602). The backward pass
+            # transposes to a per-shard scatter-add of only the rows each
+            # shard owns — the sparse gradient path, compiled by XLA.
+            return sharded_embedding_lookup(table, ids, axis)
         return jnp.take(table, ids, axis=0)
 
     def attend(self, params, x):

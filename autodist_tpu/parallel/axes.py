@@ -84,7 +84,7 @@ class ParallelSpec:
         return n_devices // fixed
 
     def build_mesh(self, devices=None):
-        """Mesh with axes (data, seq, pipe, model, expert); size-1 axes kept.
+        """Mesh with axes (data, pipe, seq, expert, model); size-1 axes kept.
 
         Axis order puts ``model`` (highest-traffic collectives) innermost so
         tensor-parallel groups land on adjacent ICI neighbors, then expert,
@@ -195,6 +195,11 @@ def manual_axis(mesh_axis):
     Returns ``mesh_axis`` only when the current step executes that mesh
     axis manually AND its size exceeds 1."""
     return mesh_axis if mesh_axis in _CTX.manual_axes else None
+
+
+def current_mesh():
+    """The mesh installed by the active sharding_ctx (or None)."""
+    return _CTX.mesh
 
 
 def live_mesh_axis(logical):

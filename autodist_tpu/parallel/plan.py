@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu.const import AXIS_DATA
+from autodist_tpu.kernels.partitioner import PartitionerConfig
 from autodist_tpu.parallel import compressor as comp
 from autodist_tpu.strategy.base import (AllReduceSynchronizer,
                                         PSSynchronizer)
@@ -78,8 +79,12 @@ class VarPlan:
         self.all_syncs = syncs
         self.is_ps = isinstance(self.sync, PSSynchronizer)
         self.is_ar = isinstance(self.sync, AllReduceSynchronizer)
-        self.num_shards = node.num_shards
-        self.partition_axis = node.partition_axis
+        # shard geometry via the partitioner math module (reference
+        # PartitionerConfig, kernel/partitioner.py:38-150)
+        self.part_config = PartitionerConfig(node.partitioner)
+        self.num_shards = self.part_config.num_shards
+        self.partition_axis = self.part_config.axis
+        self.sparse_synced = False   # set at trace time by sync_gradients
         self.staleness = getattr(self.sync, 'staleness', 0)
         self.sync_mode = getattr(self.sync, 'sync', True)
         if self.is_ar:
@@ -135,7 +140,7 @@ class ExecutionPlan:
             self.var_plans[name] = plan
         self.max_staleness = max(
             [p.staleness for p in self.var_plans.values()] + [0])
-        self.sync_mode = all(p.sync_mode for p in self.var_plans.values())
+        self._pure_sparse_cache = {}
         # loose-mode gate: any sync=True var demands its staleness bound;
         # the program-wide gate enforces the tightest one (per-variable
         # windows collapse to one window since the step is one program).
@@ -168,26 +173,131 @@ class ExecutionPlan:
             return lambda g: ring_all_reduce(g, AXIS_DATA) / n
         return lambda g: jax.lax.pmean(g, AXIS_DATA)
 
+    # -- sparse (IndexedSlices-equivalent) gradient sync ------------------
+    def _purely_sparse(self, var):
+        """True iff every consumer of ``var`` is a recorded lookup: a
+        dense use (tied embeddings, weight decay on the table, ...) puts
+        gradient mass on rows outside the looked-up set, which the sparse
+        wire would silently drop."""
+        cached = self._pure_sparse_cache.get(var.name)
+        if cached is not None:
+            return cached
+        from autodist_tpu.frontend import graph as fe
+        lookup_ops = set(map(id, var.lookup_ops))
+        read = var._read
+        pure = True
+        for node in self.graph_item.graph.nodes:
+            if not isinstance(node, fe.Op) or id(node) in lookup_ops:
+                continue
+            operands = list(node.inputs) + list(node.kwargs.values())
+            if any(x is var or (read is not None and x is read)
+                   for x in operands):
+                pure = False
+                break
+        self._pure_sparse_cache[var.name] = pure
+        return pure
+
+    def _sparse_ids(self, var, env):
+        """Traced, flattened lookup-id vector for a sparse-read var, or
+        None when the sparse path does not apply."""
+        if not getattr(var, 'sparse_read', False) or \
+                not getattr(var, 'lookup_ids', None) or \
+                len(var.shape) != 2 or not self._purely_sparse(var):
+            return None
+        from autodist_tpu.frontend import graph as fe
+        try:
+            parts = [jnp.ravel(fe.evaluate(n, env)).astype(jnp.int32)
+                     for n in var.lookup_ids]
+        except KeyError:        # ids node depends on an un-fed placeholder
+            return None
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _gather_slices(self, grad, ids):
+        """All-gather each replica's (ids, rows) — the wire format of the
+        reference's sparse sync (all_reduce_synchronizer.py:132-173
+        all_gathers IndexedSlices indices+values)."""
+        rows = jnp.take(grad, ids, axis=0)
+        all_ids = jax.lax.all_gather(ids, AXIS_DATA)       # (n, B)
+        all_rows = jax.lax.all_gather(rows, AXIS_DATA)     # (n, B, dim)
+        return all_ids, all_rows
+
+    def _sparse_allreduce(self, grad, ids):
+        """Dense-equivalent mean of per-replica sparse grads: per replica,
+        scatter-SET dedups repeated ids (rows already carry the summed
+        contribution), then summing over replicas adds distinct workers."""
+        all_ids, all_rows = self._gather_slices(grad, ids)
+
+        def body(acc, xs):
+            ids_r, rows_r = xs
+            upd = jnp.zeros_like(grad).at[ids_r].set(rows_r, mode='drop')
+            return acc + upd, None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros_like(grad),
+                              (all_ids, all_rows))
+        return acc / self.num_replicas
+
+    def _sparse_scatter_to_shard(self, plan, grad, ids):
+        """ZeRO variant: each shard owner keeps only its index range
+        (reference splits IndexedSlices by index range,
+        partitioner.py:660-684); out-of-range rows drop."""
+        n = self.num_replicas
+        shard_rows = grad.shape[0] // n
+        dim = grad.shape[1]
+        all_ids, all_rows = self._gather_slices(grad, ids)
+        offset = jax.lax.axis_index(AXIS_DATA) * shard_rows
+
+        def body(acc, xs):
+            ids_r, rows_r = xs
+            local = ids_r - offset
+            # negative indices would wrap (numpy semantics); send them
+            # out of bounds high so mode='drop' discards them
+            local = jnp.where(local >= 0, local, shard_rows)
+            upd = jnp.zeros((shard_rows, dim), grad.dtype) \
+                .at[local].set(rows_r, mode='drop')
+            return acc + upd, None
+
+        acc, _ = jax.lax.scan(
+            body, jnp.zeros((shard_rows, dim), grad.dtype),
+            (all_ids, all_rows))
+        return ShardedGrad(acc / n, 0)
+
     def sync_gradients(self, sources, grads, env):
         """Average gradients across the data axis per each var's strategy.
 
         Same-group AllReduce vars with a stateless compressor are fused
         into a single flat concatenated collective (scoped-allocator
         parity); stateful compressors (EF / PowerSGD) and PS vars are
-        reduced individually.
+        reduced individually. Sparse-read (embedding) vars ship
+        (indices, rows) instead of the dense vocab-sized gradient whenever
+        that moves fewer bytes.
         """
         if self.num_replicas == 1:
             return grads
+        n = self.num_replicas
         out = list(grads)
         fusable = {}   # (group, compressor cls, dtype) -> [idx]
         for i, (var, grad) in enumerate(zip(sources, grads)):
             plan = self.plan_for(var)
+            ids = self._sparse_ids(plan.var, env)
+            sparse_bytes = None if ids is None else \
+                n * ids.size * (grad.shape[1] + 1)
             if plan.state_sharded:
+                if ids is not None and plan.shard_axis == 0 and \
+                        grad.shape[0] % n == 0 and \
+                        sparse_bytes < grad.size // n:
+                    out[i] = self._sparse_scatter_to_shard(plan, grad, ids)
+                    plan.sparse_synced = True
+                    continue
                 # ZeRO path: reduce-scatter straight to the shard owner.
                 g = jax.lax.psum_scatter(
                     grad, AXIS_DATA, scatter_dimension=plan.shard_axis,
                     tiled=True) / self.num_replicas
                 out[i] = ShardedGrad(g, plan.shard_axis)
+            elif (ids is not None and
+                    type(plan.compressor) is comp.NoneCompressor and
+                    sparse_bytes < grad.size):
+                out[i] = self._sparse_allreduce(grad, ids)
+                plan.sparse_synced = True
             elif (plan.is_ar and plan.group is not None and
                     type(plan.compressor) in (comp.NoneCompressor,
                                               comp.HorovodCompressor)):

@@ -37,20 +37,11 @@ def replica_devices(resource_spec):
     return reps
 
 
-def _smallest_nontrivial_divisor(n):
-    """min k>=2 dividing n, else n (partitioned_ps_strategy.py:126-134)."""
-    for i in range(2, n):
-        if n % i == 0:
-            return i
-    return n
-
-
-def _smallest_non_divisor(n):
-    """min k>=2 NOT dividing n, else n (uneven variant, :125-133)."""
-    for i in range(2, n):
-        if n % i != 0:
-            return i
-    return n
+# shard-count rules live with the partitioner math
+# (kernels/partitioner.py mirrors reference kernel/partitioner.py)
+from autodist_tpu.kernels.partitioner import (                   # noqa: E402
+    smallest_non_divisor as _smallest_non_divisor,
+    smallest_nontrivial_divisor as _smallest_nontrivial_divisor)
 
 
 class PS(StrategyBuilder):
