@@ -108,23 +108,49 @@ class Trainer:
         return shardings
 
     def _opt_sharding(self, opt_state, params, param_shardings):
-        """Slot leaves with a param's shape shard like (or beyond) it."""
+        """Shard optimizer slots structurally: optax state trees mirror the
+        param treedef (Adam's mu/nu etc.), so any subtree of ``opt_state``
+        whose structure equals the params' is given the corresponding
+        param's sharding leaf-for-leaf — no shape-collision ambiguity.
+        Leaves outside such subtrees (step counters, scalars) replicate."""
+        param_def = jax.tree.structure(params)
         flat_params = jax.tree.leaves(params)
         flat_shards = jax.tree.leaves(
             param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+        replicated = NamedSharding(self.mesh, P())
+        # shape-keyed fallback for leaves inside states that do not mirror
+        # the param treedef exactly (optax.masked / multi_transform insert
+        # placeholder nodes); ambiguous shapes stay with the first match
         by_shape = {}
         for p, s in zip(flat_params, flat_shards):
             by_shape.setdefault(tuple(p.shape), s)
 
-        def place(leaf):
-            sh = by_shape.get(tuple(getattr(leaf, 'shape', ())))
+        def mirrors_params(node):
+            try:
+                return jax.tree.structure(node) == param_def
+            except Exception:
+                return False
+
+        def place(node):
+            if mirrors_params(node):
+                leaves = jax.tree.leaves(node)
+                placed = []
+                for leaf, p, sh in zip(leaves, flat_params, flat_shards):
+                    if tuple(getattr(leaf, 'shape', ())) != tuple(p.shape):
+                        placed.append(replicated)  # e.g. scalar count
+                    elif self.spec.zero >= 2:
+                        placed.append(self._zero_extend(sh, leaf.shape))
+                    else:
+                        placed.append(sh)
+                return jax.tree.unflatten(param_def, placed)
+            sh = by_shape.get(tuple(getattr(node, 'shape', ())))
             if sh is None:
-                return NamedSharding(self.mesh, P())
+                return replicated
             if self.spec.zero >= 2:
-                return self._zero_extend(sh, leaf.shape)
+                return self._zero_extend(sh, node.shape)
             return sh
 
-        return jax.tree.map(place, opt_state)
+        return jax.tree.map(place, opt_state, is_leaf=mirrors_params)
 
     def batch_sharding(self, batch):
         """Leading dim over data; dim 1 over seq for rank>=2 leaves when

@@ -74,6 +74,11 @@ class AutoDist:
     def __init__(self, resource_spec_file=None, strategy_builder=None,
                  resource_info=None):
         set_default_autodist(self)
+        if resource_spec_file is None and resource_info is None and \
+                ENV.SYS_RESOURCE_PATH.val:
+            # reference const.py:55-89: SYS_RESOURCE_PATH supplies the
+            # resource spec when the ctor doesn't
+            resource_spec_file = ENV.SYS_RESOURCE_PATH.val
         if resource_spec_file is not None:
             self._resource_spec = ResourceSpec(
                 resource_file=resource_spec_file)
@@ -140,10 +145,14 @@ class AutoDist:
                     'coord service to fetch the strategy from)')
         return s
 
-    def _compile_strategy(self, strategy):
+    def _compile_strategy(self, strategy, resolver=None, compiler=None):
         logging.debug('Raw strategy: %s', strategy)
-        compiled = strategy_base.StrategyCompiler(self._original_graph_item) \
-            .compile(strategy)
+        if compiler is None:
+            compiler = strategy_base.StrategyCompiler(
+                self._original_graph_item)
+        if resolver is not None:
+            compiler.set_device_resolver(resolver)
+        compiled = compiler.compile(strategy)
         logging.info('Compiled strategy: %s', compiled)
         return compiled
 
@@ -170,7 +179,13 @@ class AutoDist:
         addr = ENV.AUTODIST_COORD_SERVICE_ADDR.val or \
             '%s:%d' % (self._resource_spec.chief, DEFAULT_COORD_PORT)
         host, port = addr.rsplit(':', 1)
-        if IS_AUTODIST_CHIEF and is_local_address(host):
+        # The chief process runs on the chief node by definition (identity
+        # is env-based), so it hosts the service whenever the configured
+        # host names its own node — even if that NIC IP is not locally
+        # recognizable (Debian 127.0.1.1-style hostname resolution).
+        chief_hosts_service = IS_AUTODIST_CHIEF and (
+            host == self._resource_spec.chief or is_local_address(host))
+        if chief_hosts_service:
             all_local = all(is_local_address(n) for n in nodes)
             bind = '127.0.0.1' if all_local else '0.0.0.0'
             self._coord_proc = coord_client.ensure_service(
@@ -232,12 +247,26 @@ class AutoDist:
             atexit.register(self._coordinator.terminate)
 
     def _build(self):
+        from autodist_tpu.utils import visualization as viz
         self._ensure_control_plane()
+        # phase dumps (reference graph_transformer.py:62-90 logs the graph
+        # after each transform phase; AUTODIST_DUMP_GRAPHS gates ours)
+        dumping = ENV.AUTODIST_DUMP_GRAPHS.val
+        if dumping:
+            viz.log_text('\n'.join(
+                repr(n) for n in self._original_graph_item.graph.nodes),
+                '0-original-capture')
         strategy = self._build_or_load_strategy()
+        if dumping:
+            viz.log_text(strategy, '1-strategy')
         self._setup(strategy)
-        compiled = self._compile_strategy(strategy)
+        from autodist_tpu.runtime.device_resolver import DeviceResolver
+        # prune BEFORE the loose/SPMD mode decision: nodes for vars this
+        # graph doesn't have must not decide the execution mode
+        compiler = strategy_base.StrategyCompiler(self._original_graph_item)
+        strategy = compiler.prune(strategy)
         loose = ENV.AUTODIST_NUM_PROCESSES.val > 1 and \
-            self._strategy_is_loose(compiled)
+            self._strategy_is_loose(strategy)
         if loose:
             # relaxed-consistency PS: independent local programs + host PS;
             # no global SPMD runtime to form
@@ -249,11 +278,25 @@ class AutoDist:
         else:
             self._cluster.start()
             devices = None  # mesh_from_strategy uses the global view
+        resolver = None if loose else DeviceResolver(self._resource_spec)
+        compiled = self._compile_strategy(strategy, resolver=resolver,
+                                          compiler=compiler)
+        if resolver is not None and not self._resource_spec.mesh_hint:
+            # the resolved replica list decides the mesh's device order
+            # and subset (reference resolver.py:47-67 feeds TF placement)
+            sel = resolver.jax_devices_for(compiled.graph_config.replicas)
+            if sel is not None:
+                devices = sel
         mesh = mesh_from_strategy(compiled, self._resource_spec,
                                   devices=devices)
+        if dumping:
+            viz.log_text(compiled, '2-compiled-strategy')
         plan = ExecutionPlan(compiled, self._original_graph_item, mesh,
                              loose=loose)
-        logging.info(plan.describe())
+        described = plan.describe()
+        logging.info(described)
+        if dumping:
+            viz.log_text(described, '3-execution-plan')
         self._transformed = (compiled, mesh, plan)
         self._built = True
 

@@ -88,7 +88,8 @@ class ParallelSpec:
 
         Axis order puts ``model`` (highest-traffic collectives) innermost so
         tensor-parallel groups land on adjacent ICI neighbors, then expert,
-        seq, pipe, data outermost — the standard hierarchy-matching layout.
+        seq, pipe, with data outermost — the standard hierarchy-matching
+        layout.
         """
         devices = list(devices if devices is not None else jax.devices())
         dp = self.resolve_dp(len(devices))

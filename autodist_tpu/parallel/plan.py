@@ -19,6 +19,11 @@ rebuild expresses the same per-variable decisions *functionally*:
   Partitioned vars shard along the strategy's partition axis.
 - Collective "spec" NCCL/RING collapses into XLA's ICI algorithm choice;
   ``RING`` forces an explicit ppermute ring (useful over DCN).
+- Collective group/instance keys (reference collective_key.py:43-70, which
+  disambiguate concurrent TF collectives) are subsumed: within one XLA
+  program channel ids are compiler-assigned, and the cross-process data
+  plane namespaces its keys by strategy id + variable name
+  (runtime/session.py ``_key``).
 """
 import jax
 import jax.numpy as jnp
@@ -57,15 +62,25 @@ class ShardedGrad:
     Produced by :meth:`ExecutionPlan.sync_gradients` for variables whose
     optimizer state is sharded; consumed by ``Optimizer._apply`` (updates
     the local shard only) or gathered to full on direct fetch.
+
+    ``logical_dim`` records the unpadded size of the shard axis for
+    uneven partitions (UnevenPartitionedPS): physical shards are padded
+    to equal size, and :meth:`gather` slices the padding back off.
     """
 
-    def __init__(self, value, axis):
+    def __init__(self, value, axis, logical_dim=None):
         self.value = value
         self.axis = axis
+        self.logical_dim = logical_dim
 
     def gather(self):
-        return jax.lax.all_gather(self.value, AXIS_DATA, axis=self.axis,
+        full = jax.lax.all_gather(self.value, AXIS_DATA, axis=self.axis,
                                   tiled=True)
+        if self.logical_dim is not None and \
+                full.shape[self.axis] != self.logical_dim:
+            full = jax.lax.slice_in_dim(full, 0, self.logical_dim,
+                                        axis=self.axis)
+        return full
 
 
 class VarPlan:
@@ -95,11 +110,15 @@ class VarPlan:
             self.compressor = comp.create('NoneCompressor', var.name)
             self.group = None
             self.spec = 'AUTO'
-        # ZeRO-style state sharding applies to partitioned vars whose
-        # partition axis is divisible across the mesh data axis.
+        # ZeRO-style state sharding applies to partitioned vars; when the
+        # partition axis does not divide the mesh data axis (the uneven
+        # case, UnevenPartitionedPS) the physical state is zero-padded to
+        # the next multiple and the padding sliced off on every read.
         self.state_sharded = False
         self.shard_axis = self.partition_axis if \
             self.partition_axis is not None else 0
+        self.pad = 0             # physical padding rows on shard_axis
+        self.padded_dim = None   # physical (padded) size of shard_axis
 
 
 class ExecutionPlan:
@@ -133,10 +152,12 @@ class ExecutionPlan:
             plan = VarPlan(var, node)
             if shard_ps_state and plan.is_ps and len(var.shape) > 0:
                 ax = plan.shard_axis
-                if var.shape[ax] % self.num_replicas == 0 and \
-                        var.shape[ax] >= self.num_replicas and \
-                        plan.num_shards > 1:
+                n = self.num_replicas
+                if var.shape[ax] >= n and plan.num_shards > 1:
                     plan.state_sharded = True
+                    dim = int(var.shape[ax])
+                    plan.padded_dim = -(-dim // n) * n
+                    plan.pad = plan.padded_dim - dim
             self.var_plans[name] = plan
         self.max_staleness = max(
             [p.staleness for p in self.var_plans.values()] + [0])
@@ -236,12 +257,22 @@ class ExecutionPlan:
                               (all_ids, all_rows))
         return acc / self.num_replicas
 
+    def _pad_grad(self, plan, grad):
+        """Zero-pad a gradient on the shard axis for uneven partitions."""
+        if not plan.pad:
+            return grad
+        cfg = [(0, 0)] * grad.ndim
+        cfg[plan.shard_axis] = (0, plan.pad)
+        return jnp.pad(grad, cfg)
+
     def _sparse_scatter_to_shard(self, plan, grad, ids):
         """ZeRO variant: each shard owner keeps only its index range
         (reference splits IndexedSlices by index range,
-        partitioner.py:660-684); out-of-range rows drop."""
+        partitioner.py:660-684); out-of-range rows drop. Uneven
+        partitions use the padded per-shard row count — real ids never
+        land in the pad range, so padded rows stay zero."""
         n = self.num_replicas
-        shard_rows = grad.shape[0] // n
+        shard_rows = (grad.shape[0] + plan.pad) // n
         dim = grad.shape[1]
         all_ids, all_rows = self._gather_slices(grad, ids)
         offset = jax.lax.axis_index(AXIS_DATA) * shard_rows
@@ -259,7 +290,7 @@ class ExecutionPlan:
         acc, _ = jax.lax.scan(
             body, jnp.zeros((shard_rows, dim), grad.dtype),
             (all_ids, all_rows))
-        return ShardedGrad(acc / n, 0)
+        return ShardedGrad(acc / n, 0, logical_dim=grad.shape[0])
 
     def sync_gradients(self, sources, grads, env):
         """Average gradients across the data axis per each var's strategy.
@@ -283,16 +314,19 @@ class ExecutionPlan:
                 n * ids.size * (grad.shape[1] + 1)
             if plan.state_sharded:
                 if ids is not None and plan.shard_axis == 0 and \
-                        grad.shape[0] % n == 0 and \
                         sparse_bytes < grad.size // n:
                     out[i] = self._sparse_scatter_to_shard(plan, grad, ids)
                     plan.sparse_synced = True
                     continue
-                # ZeRO path: reduce-scatter straight to the shard owner.
+                # ZeRO path: reduce-scatter straight to the shard owner;
+                # uneven partitions pad to the next multiple of the mesh.
+                g = self._pad_grad(plan, grad)
                 g = jax.lax.psum_scatter(
-                    grad, AXIS_DATA, scatter_dimension=plan.shard_axis,
+                    g, AXIS_DATA, scatter_dimension=plan.shard_axis,
                     tiled=True) / self.num_replicas
-                out[i] = ShardedGrad(g, plan.shard_axis)
+                out[i] = ShardedGrad(
+                    g, plan.shard_axis,
+                    logical_dim=grad.shape[plan.shard_axis])
             elif (ids is not None and
                     type(plan.compressor) is comp.NoneCompressor and
                     sparse_bytes < grad.size):
@@ -329,6 +363,34 @@ class ExecutionPlan:
                     grads[i].shape)
                 offset += size
         return out
+
+    # -- padded physical layout (uneven partitions) ------------------------
+    def padded_shape(self, var_name):
+        """Physical (device) shape of a variable's state array."""
+        plan = self.var_plans.get(var_name)
+        if plan is None:
+            return None
+        shape = list(plan.var.shape)
+        if plan.state_sharded and plan.pad:
+            shape[plan.shard_axis] = plan.padded_dim
+        return tuple(shape)
+
+    def pad_host(self, var_name, value):
+        """Logical host value -> physical (padded) layout."""
+        plan = self.var_plans.get(var_name)
+        if plan is None or not (plan.state_sharded and plan.pad):
+            return value
+        return self._pad_grad(plan, jnp.asarray(value))
+
+    def unpad_host(self, var_name, value):
+        """Physical layout -> logical host value."""
+        plan = self.var_plans.get(var_name)
+        if plan is None or not (plan.state_sharded and plan.pad):
+            return value
+        dim = plan.var.shape[plan.shard_axis]
+        slicer = [slice(None)] * value.ndim
+        slicer[plan.shard_axis] = slice(0, dim)
+        return value[tuple(slicer)]
 
     # -- state shardings (used by the Session when placing arrays) --------
     def var_sharding(self, var_name):
@@ -371,14 +433,24 @@ class ExecutionPlan:
         """Human-readable lowering summary (logged like the reference logs
         its compiled strategy, autodist.py:117)."""
         lines = ['ExecutionPlan over mesh %s:' % dict(self.mesh.shape)]
+        if any(p.is_ps and getattr(p.sync, 'reduction_destination', '')
+               for p in self.var_plans.values()):
+            lines.append(
+                '  (PS reduction destinations are advisory under SPMD: '
+                'state shards over the mesh, collectives replace '
+                'push/pull; destinations matter for loose-mode PS '
+                'placement and capacity planning only)')
         for name, p in self.var_plans.items():
             kind = 'AllReduce' if p.is_ar else 'PS'
             extra = ''
+            if p.is_ps and getattr(p.sync, 'reduction_destination', ''):
+                extra += ' dest=%s' % p.sync.reduction_destination
             if p.num_shards > 1:
                 extra += ' shards=%d axis=%s' % (p.num_shards,
                                                  p.partition_axis)
             if p.state_sharded:
-                extra += ' [ZeRO-sharded]'
+                extra += ' [ZeRO-sharded%s]' % (
+                    ' pad=%d' % p.pad if p.pad else '')
             if p.is_ar:
                 extra += ' group=%s compressor=%s' % (
                     p.group, type(p.compressor).__name__)

@@ -30,6 +30,16 @@ def is_local_address(address):
             local_ips.add(socket.gethostbyname(socket.gethostname()))
         except OSError:
             pass
+        try:
+            # primary-NIC IP (Debian-style hosts resolve the hostname to
+            # 127.0.1.1, missing the real interface address); a UDP
+            # connect() learns the outbound IP without sending packets
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(('192.0.2.1', 9))   # TEST-NET, never routed to
+            local_ips.add(s.getsockname()[0])
+            s.close()
+        except OSError:
+            pass
         return address in local or address in local_ips
     except OSError:
         return False

@@ -82,6 +82,23 @@ class Session:
         # namespace coord-service keys by strategy id: a reused/leaked
         # service must not serve a previous run's vars or step counters
         self._ns = getattr(plan.strategy, 'id', 'default')
+        # proxy variables (reference proxy_variable.py:46-190): a worker-
+        # local cached copy serves reads. In SPMD programs reads are
+        # already device-local, so the proxy is inherently satisfied; in
+        # loose mode it is real: the pre-step PS pull is replaced by the
+        # cache, refreshed from the PS after each push (the reference's
+        # post-update assign, proxy_variable.py:163-190).
+        self._proxy_vars = {
+            name for name, p in plan.var_plans.items()
+            if p.is_ps and any(getattr(s, 'local_replication', False)
+                               for s in p.all_syncs)}
+        self._proxy_cache = {}
+        self._proxy_hits = 0
+        if self._proxy_vars and not self._loose:
+            logging.info(
+                'local_proxy_variable on %d vars: subsumed by SPMD '
+                '(variable reads are device-local in a single program)',
+                len(self._proxy_vars))
         # graph-mutation guard (reference autodist.py:152-165): the
         # captured program must not grow after the session is built.
         # VariableRead nodes are excluded: they are framework-internal and
@@ -166,7 +183,8 @@ class Session:
         self._var_state = {}
         for name, var in self._graph_item.graph.variables.items():
             self._var_state[name] = self._put(
-                jnp.asarray(var.init_value), plan.var_sharding(name))
+                plan.pad_host(name, jnp.asarray(var.init_value)),
+                plan.var_sharding(name))
         # per-optimizer slot state {uid: {var name: optax leaf state}};
         # one optimizer may appear in several ApplyGradients nodes — merge
         # the variable sets rather than keeping only the first node's.
@@ -208,8 +226,9 @@ class Session:
                     for k, v in aux.items()}
 
     def _place_slots(self, var_name, leafstate):
-        """Shard optimizer slots like their variable (ZeRO); scalars
-        (e.g. step counts) replicate."""
+        """Shard optimizer slots like their variable (ZeRO, padded like
+        the variable for uneven partitions); scalars (e.g. step counts)
+        replicate."""
         var = self._graph_item.var_by_name(var_name)
         sharding = self._plan.var_sharding(var_name)
         repl = self._plan.replicated_sharding()
@@ -217,14 +236,19 @@ class Session:
         def place(leaf):
             if hasattr(leaf, 'shape') and tuple(leaf.shape) == \
                     tuple(var.shape):
-                return self._put(jnp.asarray(leaf), sharding)
+                return self._put(
+                    self._plan.pad_host(var_name, jnp.asarray(leaf)),
+                    sharding)
             return self._put(jnp.asarray(leaf), repl)
 
         return jax.tree.map(place, leafstate)
 
     def _slot_spec(self, var_name, leaf):
-        var = self._graph_item.var_by_name(var_name)
-        if hasattr(leaf, 'shape') and tuple(leaf.shape) == tuple(var.shape):
+        # placed slots carry the variable's physical (padded) shape
+        phys = self._plan.padded_shape(var_name)
+        if phys is None:
+            phys = self._graph_item.var_by_name(var_name).shape
+        if hasattr(leaf, 'shape') and tuple(leaf.shape) == tuple(phys):
             return self._plan.var_spec(var_name)
         return P()
 
@@ -258,7 +282,8 @@ class Session:
         key = (tuple(id(f) for f in norm),
                tuple((id(p), v.shape, str(v.dtype), s)
                      for p, v, s in zip(feed_nodes, feed_vals, split_flags)))
-        if key not in self._cache:
+        first_compile = key not in self._cache
+        if first_compile:
             self._cache[key] = self._build_step(norm, feed_nodes,
                                                 split_flags)
         fn = self._cache[key]
@@ -280,6 +305,14 @@ class Session:
         for v, split in zip(feed_vals, split_flags):
             placed.append(self._put_feed(v, P(AXIS_DATA) if split
                                          else P()))
+
+        if first_compile and ENV.AUTODIST_DUMP_GRAPHS.val:
+            # final-phase program dump (reference '3-transformed' graph)
+            from autodist_tpu.utils import visualization as viz
+            viz.log_compiled(
+                fn.lower(self._var_state, self._opt_state,
+                         self._aux_state, placed),
+                '4-lowered-step-%d' % len(self._cache))
 
         tracing = options is not None and \
             getattr(options, 'trace_level', 0) > 0
@@ -315,14 +348,21 @@ class Session:
         values for delta computation."""
         pulled = {}
         for name, var in self._graph_item.graph.variables.items():
-            served = self._coord.vget(self._key('var/%s' % name),
-                                      shape=var.shape)
-            if served is None:   # pragma: no cover - init barrier ensures
-                served = np.asarray(var.init_value, dtype=np.float32)
-            served = served.astype(var.init_value.dtype)
+            if name in self._proxy_vars and name in self._proxy_cache:
+                # proxy read: serve from the local cache, no PS round-trip
+                # on the pre-step critical path
+                served = self._proxy_cache[name]
+                self._proxy_hits += 1
+            else:
+                served = self._coord.vget(self._key('var/%s' % name),
+                                          shape=var.shape)
+                if served is None:  # pragma: no cover - init barrier
+                    served = np.asarray(var.init_value, dtype=np.float32)
+                served = served.astype(var.init_value.dtype)
             pulled[name] = served
             self._var_state[name] = self._put(
-                jnp.asarray(served), self._plan.var_sharding(name))
+                self._plan.pad_host(name, jnp.asarray(served)),
+                self._plan.var_sharding(name))
         return pulled
 
     def _push_ps_deltas(self, pulled):
@@ -334,6 +374,15 @@ class Session:
             delta = np.asarray(after, dtype=np.float32) - \
                 np.asarray(before, dtype=np.float32)
             self._coord.vadd(self._key('var/%s' % name), delta)
+        for name in self._proxy_vars:
+            # post-update assign (proxy_variable.py:163-190): refresh the
+            # proxy from the PS after the push, off the pre-step path
+            var = self._graph_item.var_by_name(name)
+            served = self._coord.vget(self._key('var/%s' % name),
+                                      shape=var.shape)
+            if served is not None:
+                self._proxy_cache[name] = \
+                    served.astype(var.init_value.dtype)
 
     def _contract(self, fetch, stacked, split_sizes):
         """Apply the reference fetch contract to the per-replica stack."""
@@ -386,9 +435,9 @@ class Session:
             full = dict(var_state)
             for name in sharded_vars:
                 p = plan.var_plans[name]
-                full[name] = jax.lax.all_gather(
-                    var_state[name], AXIS_DATA, axis=p.shard_axis,
-                    tiled=True)
+                full[name] = ShardedGrad(
+                    var_state[name], p.shard_axis,
+                    logical_dim=p.var.shape[p.shard_axis]).gather()
             # strip the per-replica leading dim for in-step aux access
             aux_local = jax.tree.map(lambda x: x[0], aux_state)
             env = fe.Env(full, dict(zip(feed_nodes, feeds)),
@@ -448,15 +497,16 @@ class Session:
     def _local_value(self, name):
         arr = self._var_state[name]
         if getattr(arr, 'is_fully_addressable', True):
-            return np.asarray(arr)
+            return np.asarray(self._plan.unpad_host(name, np.asarray(arr)))
         sharding = getattr(arr, 'sharding', None)
         if sharding is not None and sharding.is_fully_replicated:
             return np.asarray(arr.addressable_shards[0].data)
         # cross-process sharded state: gather (collective — every process
         # must make this call)
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            arr, tiled=True))
+        return np.asarray(self._plan.unpad_host(
+            name, np.asarray(multihost_utils.process_allgather(
+                arr, tiled=True))))
 
     def get_variable_value(self, var):
         name = var.name if isinstance(var, fe.Variable) else var
@@ -471,6 +521,7 @@ class Session:
     def load_variable_value(self, var, value):
         name = var.name if isinstance(var, fe.Variable) else var
         self._var_state[name] = self._put(
-            jnp.asarray(value), self._plan.var_sharding(name))
+            self._plan.pad_host(name, jnp.asarray(value)),
+            self._plan.var_sharding(name))
         if self._loose and self._is_chief:
             self._coord.vset(self._key('var/%s' % name), np.asarray(value))

@@ -192,7 +192,11 @@ class StrategyCompiler:
         self._device_resolver = resolver
         return self
 
-    def _prune_nodes(self, strategy):
+    def prune(self, strategy):
+        """Drop node configs for variables this graph does not have
+        (reference base.py:137-168 prunes stateless vars). Idempotent;
+        callers may prune early (e.g. before the execution-mode decision)
+        and still pass the result through :meth:`compile`."""
         known = set(self._graph_item.trainable_var_op_to_var.keys())
         kept = [n for n in strategy.node_config if n.var_name in known]
         dropped = [n.var_name for n in strategy.node_config
@@ -217,7 +221,7 @@ class StrategyCompiler:
         return strategy
 
     def compile(self, strategy):
-        strategy = self._prune_nodes(strategy)
+        strategy = self.prune(strategy)
         strategy = self._resolve_devices(strategy)
         return strategy
 

@@ -52,6 +52,19 @@ def log_program(fn, args, phase, kwargs=None, static_argnums=()):
     return base
 
 
+def log_text(content, phase):
+    """Dump a text artifact (captured graph, strategy, plan) for one
+    build phase (reference dumps the graph at 4 transform phases,
+    graph_transformer.py:62-90)."""
+    if not ENV.AUTODIST_DUMP_GRAPHS.val:
+        return None
+    base = os.path.join(_run_dir(), phase)
+    with open(base + '.txt', 'w') as f:
+        f.write(str(content))
+    logging.info('Dumped %r under %s', phase, _run_dir())
+    return base
+
+
 def log_compiled(lowered_or_compiled, phase):
     """Dump an already-lowered/compiled jax artifact's HLO text."""
     if not ENV.AUTODIST_DUMP_GRAPHS.val:

@@ -199,6 +199,28 @@ def test_staleness_bounds_fast_worker(tmp_path):
 
 
 @pytest.mark.integration
+def test_proxy_variable_serves_reads_from_cache(tmp_path):
+    """local_proxy_variable in loose mode: pre-step reads come from the
+    worker-local proxy (refreshed post-push, reference
+    proxy_variable.py:163-190); staleness semantics still hold and both
+    workers' updates still reach the PS."""
+    body = STALENESS_BODY % {
+        'builder_kwargs': 'staleness=3, local_proxy_variable=True'}
+    body = body.replace(
+        "print('RESULT ' + json.dumps({'role': ROLE, 'lead': lead,",
+        "proxy_hits = sess._proxy_hits\n"
+        "print('RESULT ' + json.dumps({'role': ROLE, 'lead': lead,"
+        " 'proxy_hits': proxy_hits,")
+    results = launch_pair(tmp_path, body, timeout=420)
+    chief = next(r for r in results if r['role'] == 'chief')
+    assert max(chief['lead']) <= 3, chief['lead']
+    for r in results:
+        # 8 steps x 2 vars; all pulls after the first step hit the proxy
+        assert r['proxy_hits'] >= 14, r
+        assert abs(r['b']) > 1e-4
+
+
+@pytest.mark.integration
 def test_async_ps_never_blocks(tmp_path):
     """sync=False: unconditional no-wait — the fast chief finishes all
     steps while the slow worker lags far beyond any staleness bound."""

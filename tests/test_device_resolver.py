@@ -1,0 +1,92 @@
+"""DeviceResolver: abstract -> canonical/jax device mapping
+(reference kernel/device/resolver.py:47-67)."""
+import numpy as np
+
+import autodist_tpu as ad
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.device_resolver import DeviceResolver
+from autodist_tpu.strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy, StrategyNode, \
+    AllReduceSynchronizer, PSSynchronizer, StrategyCompiler
+
+
+def two_node_spec():
+    return ResourceSpec(resource_info={'nodes': [
+        {'address': '10.20.41.0', 'gpus': [0, 1], 'chief': True},
+        {'address': '10.20.41.1', 'gpus': [0, 1]},
+    ]})
+
+
+def test_chief_first_task_numbering():
+    """Launchers assign process ids chief-first; the resolver must use the
+    same ordering even when the chief is not the first spec entry."""
+    spec = ResourceSpec(resource_info={'nodes': [
+        {'address': '10.20.41.0', 'gpus': [0]},
+        {'address': '10.20.41.1', 'gpus': [0], 'chief': True},
+    ]})
+    r = DeviceResolver(spec)
+    assert r('10.20.41.1:GPU:0') == '/job:worker/task:0/device:GPU:0'
+    assert r('10.20.41.0:GPU:0') == '/job:worker/task:1/device:GPU:0'
+
+
+def test_canonical_strings():
+    r = DeviceResolver(two_node_spec())
+    assert r('10.20.41.0:GPU:1') == '/job:worker/task:0/device:GPU:1'
+    assert r('10.20.41.1:CPU:0') == '/job:worker/task:1/device:CPU:0'
+    # unresolvable strings pass through unchanged
+    assert r('10.9.9.9:GPU:0') == '10.9.9.9:GPU:0'
+
+
+def test_canonical_roundtrip_resolves():
+    r = DeviceResolver(two_node_spec())
+    canon = r('10.20.41.0:GPU:1')
+    assert r.resolve(canon).canonical == canon
+
+
+def test_compiler_resolves_strategy_devices():
+    spec = two_node_spec()
+    s = Strategy()
+    s.graph_config.replicas = ['10.20.41.0:GPU:0', '10.20.41.1:GPU:0']
+    s.node_config.append(StrategyNode(
+        var_name='w', synchronizer=PSSynchronizer(
+            reduction_destination='10.20.41.0:CPU:0')))
+
+    class GI:  # minimal graph-item stub for pruning
+        trainable_var_op_to_var = {'w': None}
+
+    compiled = StrategyCompiler(GI()).set_device_resolver(
+        DeviceResolver(spec)).compile(s)
+    assert compiled.graph_config.replicas == [
+        '/job:worker/task:0/device:GPU:0',
+        '/job:worker/task:1/device:GPU:0']
+    assert compiled.node_config[0].synchronizer.reduction_destination == \
+        '/job:worker/task:0/device:CPU:0'
+
+
+def test_replica_order_drives_mesh_devices():
+    """The strategy's replica list picks the mesh's device subset+order."""
+    import jax
+
+    class ReorderedAR(AllReduce):
+        def build(self, graph_item, resource_spec):
+            s = super().build(graph_item, resource_spec)
+            s.graph_config.replicas = [
+                'localhost:GPU:6', 'localhost:GPU:4', 'localhost:GPU:2',
+                'localhost:GPU:0']
+            return s
+
+    autodist = ad.AutoDist(
+        resource_info={'nodes': [{'address': 'localhost',
+                                  'gpus': list(range(8)), 'chief': True}]},
+        strategy_builder=ReorderedAR())
+    with autodist.scope():
+        w = ad.Variable(1.0, name='w')
+        train_op = ad.optimizers.SGD(0.1).minimize(
+            ad.ops.square(w.read()), [w])
+        sess = autodist.create_distributed_session()
+        sess.run(train_op)
+    _, mesh, _ = autodist._transformed
+    ids = [d.id for d in mesh.devices.flat]
+    expected = [sorted(d.id for d in jax.devices())[i]
+                for i in (6, 4, 2, 0)]
+    assert ids == expected
