@@ -158,10 +158,9 @@ class TransformerLM(Module):
                     'pipeline parallelism requires scan_layers=True '
                     '(blocks must be stage-stacked to shard over pipe)')
             from autodist_tpu.parallel.pipeline import gpipe
-            # aux (MoE balance) loss is dropped under pipelining: the
-            # GPipe carry is the activation alone
-            x = gpipe(lambda p, h: block_fn(p, h)[0], params['blocks'],
-                      x, pipe_axis, ctx_option('microbatches', 1))
+            x, aux_pipe = gpipe(block_fn, params['blocks'], x, pipe_axis,
+                                ctx_option('microbatches', 1))
+            aux_total = aux_total + aux_pipe
         elif cfg.scan_layers:
             def body(carry, layer_params):
                 h, aux = carry

@@ -8,6 +8,16 @@ activations hop stage-to-stage with ``ppermute`` following the GPipe
 schedule (microbatches fill/drain the pipe; bubble fraction
 (pp-1)/(M+pp-1)). Autodiff through ppermute gives the backward schedule
 for free; XLA overlaps the hop DMA with the next microbatch's compute.
+
+Fill/drain efficiency: each rank r only holds a *valid* microbatch for
+schedule steps t in [r, r+M); outside that window the block compute is
+skipped via ``lax.cond`` (a real XLA conditional — ``rank``/``t`` are
+runtime values inside the manual region), so the inherent bubble idles
+instead of burning FLOPs on garbage activations. Wall-clock per step is
+still one block time (some rank is always busy, and the per-step
+``ppermute`` aligns ranks), so the schedule's latency overhead remains
+the textbook (pp-1)/(M+pp-1) bubble — measured in
+tests/test_functional_api.py's pipeline parity tests.
 """
 import jax
 import jax.numpy as jnp
@@ -20,7 +30,9 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
     Must be called inside a shard_map region manual over ``axis_name``.
 
     Args:
-        block_fn: ``block_fn(layer_params, h) -> h`` single-block apply.
+        block_fn: ``block_fn(layer_params, h) -> (h, aux)`` single-block
+            apply; ``aux`` is a scalar auxiliary loss contribution (e.g.
+            MoE router balance) summed over layers.
         stacked_params: pytree with local leading dim = layers_per_stage.
         x: [batch, ...] full activation batch (replicated over the pipe
             axis — every rank holds it; only rank 0's copy is consumed).
@@ -28,7 +40,9 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
         microbatches: M, the microbatch count (batch must divide by M).
 
     Returns:
-        [batch, ...] final activations, replicated over the pipe axis.
+        ``(out, aux)``: [batch, ...] final activations and the scalar aux
+        loss (mean over microbatches, summed over all stages' layers),
+        both replicated over the pipe axis.
     """
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -40,9 +54,12 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
 
     def local_stack(h):
         def body(c, p):
-            return block_fn(p, c), None
-        h, _ = lax.scan(body, h, stacked_params)
-        return h
+            h, aux = c
+            h, a = block_fn(p, h)
+            return (h, aux + a.astype(jnp.float32)), None
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                               stacked_params)
+        return h, aux
 
     if pp == 1:
         return local_stack(x)
@@ -50,13 +67,19 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
     def step(carry, t):
-        state, buf = carry
+        state, buf, aux_acc = carry
         # stage 0 consumes microbatch t (clamped in the drain phase);
         # other stages consume what the previous stage sent
         mb_idx = jnp.clip(t, 0, M - 1)
         first_in = lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
         inp = jnp.where(rank == 0, first_in, state)
-        out = local_stack(inp)
+        # rank r holds valid work only for t in [r, r+M): skip the block
+        # compute in the fill/drain bubble instead of processing garbage
+        valid = jnp.logical_and(t >= rank, t < rank + M)
+        out, aux = lax.cond(
+            valid, local_stack,
+            lambda h: (h, jnp.zeros((), jnp.float32)), inp)
+        aux_acc = aux_acc + aux
         # last stage records microbatch t-(pp-1) once the pipe is full
         out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
         ready = jnp.logical_and(rank == pp - 1, t >= pp - 1)
@@ -64,15 +87,19 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
         buf = lax.dynamic_update_index_in_dim(
             buf, jnp.where(ready, out, prev), out_idx, 0)
         nxt = lax.ppermute(out, axis_name, fwd_perm)
-        return (nxt, buf), None
+        return (nxt, buf, aux_acc), None
 
     state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
     buf = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
-    (_, buf), _ = lax.scan(step, (state, buf),
-                           jnp.arange(M + pp - 1))
+    (_, buf, aux_acc), _ = lax.scan(
+        step, (state, buf, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + pp - 1))
     out = buf.reshape(B, *x.shape[1:])
     # broadcast the last stage's result to every rank (the head/loss run
     # replicated over pipe): mask + psum
     out = lax.psum(
         jnp.where(rank == pp - 1, out, jnp.zeros_like(out)), axis_name)
-    return out
+    # aux: every stage accumulated its local layers' contribution for the
+    # M valid microbatches; sum stages, average microbatches
+    aux = lax.psum(aux_acc, axis_name) / M
+    return out, aux
