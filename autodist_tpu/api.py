@@ -281,15 +281,16 @@ class Trainer:
 
         return step_fn
 
-    def step(self, state, batch):
-        """One optimizer step; returns (new_state, metrics)."""
+    def _step_key(self, batch):
         struct = jax.tree.structure(batch)
         shapes = tuple((tuple(np.shape(x)), np.asarray(x).dtype.str
                         if not hasattr(x, 'dtype') else str(x.dtype))
                        for x in jax.tree.leaves(batch))
-        key = (struct, shapes)
+        return (struct, shapes)
+
+    def _ensure_step(self, key, state, batch):
         if key not in self._step_cache:
-            step_fn = self._build_step(struct)
+            step_fn = self._build_step(jax.tree.structure(batch))
             param_sh = self._param_sharding_tree(state.params)
             opt_sh = self._opt_sharding(state.opt_state, state.params,
                                         param_sh)
@@ -300,8 +301,27 @@ class Trainer:
                 in_shardings=(state_sh, self.batch_sharding(batch)),
                 out_shardings=(state_sh, None),
                 donate_argnums=(0,) if self._donate else ())
+        return self._step_cache[key]
+
+    def compile_step(self, state, batch):
+        """AOT-compile the step for this batch signature, ONCE, and make
+        subsequent ``step`` calls with the same signature reuse the same
+        executable. Returns the ``jax.stages.Compiled`` (which exposes
+        ``cost_analysis()`` — used by bench.py for FLOP cross-checks)."""
+        key = self._step_key(batch)
+        fn = self._ensure_step(key, state, batch)
+        if isinstance(fn, jax.stages.Compiled):
+            return fn
+        compiled = fn.lower(state, self.shard_batch(batch)).compile()
+        self._step_cache[key] = compiled
+        return compiled
+
+    def step(self, state, batch):
+        """One optimizer step; returns (new_state, metrics)."""
+        key = self._step_key(batch)
+        fn = self._ensure_step(key, state, batch)
         batch = self.shard_batch(batch)
-        return self._step_cache[key](state, batch)
+        return fn(state, batch)
 
     # -- fetch helpers (reference get-variable parity) ---------------------
     def get_params(self, state):

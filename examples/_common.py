@@ -1,0 +1,51 @@
+"""Shared example bootstrap: repo-root import path + JAX env overrides.
+
+This image's sitecustomize registers the TPU PJRT plugin and pins
+JAX_PLATFORMS in every interpreter, so the usual ``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8`` incantation is
+silently ignored; ``jax.config.update`` after import is the reliable
+override (same workaround as tests/conftest.py). Importing this module
+makes the documented incantation work for the examples.
+"""
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _apply_jax_env_overrides():
+    import jax
+
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        jax.config.update('jax_platforms', plat)
+    m = re.search(r'xla_force_host_platform_device_count=(\d+)',
+                  os.environ.get('XLA_FLAGS', ''))
+    if m:
+        jax.config.update('jax_num_cpu_devices', int(m.group(1)))
+
+
+_apply_jax_env_overrides()
+
+
+def timed_steps(trainer, state, batch, steps):
+    """Shared benchmark harness: AOT-compile the step once, place the
+    sharded batch on device once, warm up, then time ``steps`` calls of
+    the compiled executable.
+
+    Returns ``(state, last_loss, elapsed_s)``. The host readback
+    (``float``) is the reliable fence — ``block_until_ready`` can return
+    early through remote-device tunnels.
+    """
+    import time
+
+    compiled = trainer.compile_step(state, batch)
+    batch = trainer.shard_batch(batch)
+    state, metrics = compiled(state, batch)   # warmup
+    float(metrics['loss'])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, batch)
+    loss = float(metrics['loss'])
+    return state, loss, time.perf_counter() - t0
