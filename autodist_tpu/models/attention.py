@@ -10,8 +10,9 @@ extension axes of the strategy space.
 import jax.numpy as jnp
 
 from autodist_tpu.const import AXIS_SEQUENCE
+from autodist_tpu.kernels import flash_attention as fa
 from autodist_tpu.models.core import Dense, Module, constrain
-from autodist_tpu.parallel.axes import manual_axis
+from autodist_tpu.parallel.axes import manual_axis, unsharded_execution
 from autodist_tpu.parallel.ring_attention import (local_flash_attention,
                                                   ring_attention)
 
@@ -48,6 +49,10 @@ class MultiHeadAttention(Module):
         seq_axis = manual_axis(AXIS_SEQUENCE)
         if seq_axis is not None:
             o = ring_attention(q, k, v, seq_axis, causal=self.causal)
+        elif unsharded_execution() and fa.preferred(q.shape):
+            # device-local long-seq data: the Pallas flash kernel (never
+            # materializes the [s, s] score matrix in HBM)
+            o = fa.flash_attention(q, k, v, causal=self.causal)
         else:
             o = local_flash_attention(q, k, v, causal=self.causal)
             o = constrain(o, ('batch', 'heads', 'seq', 'kv'))

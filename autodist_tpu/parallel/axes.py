@@ -203,6 +203,20 @@ def current_mesh():
     return _CTX.mesh
 
 
+def unsharded_execution():
+    """True when the current trace computes on purely device-local data:
+    no mesh, a single-device mesh, or every size>1 mesh axis manual
+    (shard_map). This is the safety condition for invoking an opaque
+    kernel (``pallas_call``) that GSPMD cannot partition — under
+    automatic sharding XLA would all-gather its operands instead."""
+    if _CTX.mesh is None:
+        return True
+    for name, size in _CTX.mesh.shape.items():
+        if size > 1 and name not in _CTX.manual_axes:
+            return False
+    return True
+
+
 def live_mesh_axis(logical):
     """Mesh axis a logical axis is currently bound to (size>1), or None.
 

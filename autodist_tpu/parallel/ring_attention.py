@@ -10,9 +10,10 @@ softmax (flash-attention accumulation). Communication overlaps compute on
 TPU because XLA's latency-hiding scheduler overlaps the ppermute DMA with
 the per-block matmuls.
 
-Runs inside ``shard_map``; the inner block kernel is pure jnp so the same
-code executes on the CPU test mesh. A Pallas flash kernel can be slotted
-in as the block primitive on real TPU (kernels/flash_attention.py).
+Runs inside ``shard_map``; the inner block math is pure jnp (XLA fuses
+it into the ring schedule) so the same code executes on the CPU test
+mesh. The single-device long-sequence path uses the Pallas flash kernel
+instead (kernels/flash_attention.py via models/attention.py).
 """
 import jax
 import jax.numpy as jnp
