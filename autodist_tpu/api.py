@@ -228,7 +228,8 @@ class Trainer:
         rules = self.rules
         mesh = self.mesh
         manual = self.manual_axes
-        options = {'microbatches': self.spec.microbatches}
+        options = {'microbatches': self.spec.microbatches,
+                   'sp_mode': getattr(self.spec, 'sp_mode', 'ring')}
 
         def per_token(params, batch):
             with sharding_ctx(mesh, rules, manual_axes=manual,

@@ -12,9 +12,11 @@ import jax.numpy as jnp
 from autodist_tpu.const import AXIS_SEQUENCE
 from autodist_tpu.kernels import flash_attention as fa
 from autodist_tpu.models.core import Dense, Module, constrain
-from autodist_tpu.parallel.axes import manual_axis, unsharded_execution
+from autodist_tpu.parallel.axes import (ctx_option, manual_axis,
+                                        unsharded_execution)
 from autodist_tpu.parallel.ring_attention import (local_flash_attention,
                                                   ring_attention)
+from autodist_tpu.parallel.ulysses import ulysses_attention
 
 
 class MultiHeadAttention(Module):
@@ -48,7 +50,11 @@ class MultiHeadAttention(Module):
 
         seq_axis = manual_axis(AXIS_SEQUENCE)
         if seq_axis is not None:
-            o = ring_attention(q, k, v, seq_axis, causal=self.causal)
+            if ctx_option('sp_mode', 'ring') == 'ulysses':
+                o = ulysses_attention(q, k, v, seq_axis,
+                                      causal=self.causal)
+            else:
+                o = ring_attention(q, k, v, seq_axis, causal=self.causal)
         elif unsharded_execution() and fa.preferred(q.shape):
             # device-local long-seq data: the Pallas flash kernel (never
             # materializes the [s, s] score matrix in HBM)

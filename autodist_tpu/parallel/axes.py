@@ -62,6 +62,7 @@ class ParallelSpec:
     zero: int = 1
     remat: str = 'none'
     microbatches: int = 1          # pipeline microbatches (pp>1)
+    sp_mode: str = 'ring'          # 'ring' | 'ulysses' (sp>1 attention)
     rules: list = field(default_factory=lambda: [list(r)
                                                  for r in DEFAULT_RULES])
 

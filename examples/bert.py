@@ -27,6 +27,8 @@ def main():
     p.add_argument('--tp', type=int, default=1)
     p.add_argument('--pp', type=int, default=1)
     p.add_argument('--sp', type=int, default=1)
+    p.add_argument('--sp-mode', default='ring',
+                   choices=['ring', 'ulysses'])
     p.add_argument('--zero', type=int, default=1)
     p.add_argument('--microbatches', type=int, default=1)
     p.add_argument('--fp32', action='store_true')
@@ -49,7 +51,8 @@ def main():
     model = TransformerLM(cfg)
     opt = (optax.lamb if args.optimizer == 'lamb' else optax.adamw)(args.lr)
     spec = ParallelSpec(dp=args.dp, tp=args.tp, pp=args.pp, sp=args.sp,
-                        zero=args.zero, microbatches=args.microbatches)
+                        sp_mode=args.sp_mode, zero=args.zero,
+                        microbatches=args.microbatches)
     trainer = Trainer(model, opt, spec=spec)
     state = trainer.init(jax.random.PRNGKey(0))
 

@@ -50,6 +50,8 @@ def dp_losses(tiny_lm, batch):
     dict(tp=2),
     dict(tp=2, sp=2),
     dict(sp=8, dp=1),
+    dict(sp=4, dp=2, sp_mode='ulysses'),
+    dict(tp=2, sp=2, sp_mode='ulysses'),
     dict(zero=2),
     dict(zero=3),
     dict(tp=4, dp=2),
@@ -118,6 +120,41 @@ def test_ring_attention_matches_dense():
             out_specs=P(None, None, 'seq')))
         err = float(jnp.max(jnp.abs(f(q, k, v) - ref)))
         assert err < 1e-5, (causal, err)
+
+
+def test_ulysses_attention_matches_dense():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from autodist_tpu.parallel.ulysses import ulysses_attention
+    B, H, S, D = 2, 4, 64, 16
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype('f4'))
+               for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ('seq',))
+    for causal in (True, False):
+        ref = local_flash_attention(q, k, v, causal=causal)
+        f = jax.jit(jax.shard_map(
+            lambda q, k, v, c=causal: ulysses_attention(q, k, v, 'seq',
+                                                        causal=c),
+            mesh=mesh, in_specs=(P(None, None, 'seq'),) * 3,
+            out_specs=P(None, None, 'seq')))
+        err = float(jnp.max(jnp.abs(f(q, k, v) - ref)))
+        assert err < 1e-5, (causal, err)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from autodist_tpu.parallel.ulysses import ulysses_attention
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 3, 32, 8).astype('f4'))  # 3 heads, sp=4
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ('seq',))
+    f = jax.shard_map(
+        lambda q: ulysses_attention(q, q, q, 'seq'),
+        mesh=mesh, in_specs=(P(None, None, 'seq'),),
+        out_specs=P(None, None, 'seq'))
+    with pytest.raises(ValueError, match='heads'):
+        jax.jit(f)(q)
 
 
 def test_ring_attention_grads_match_dense():
