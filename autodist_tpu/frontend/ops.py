@@ -163,6 +163,48 @@ def embedding_lookup(params, ids):
     return gather(params, ids, axis=0)
 
 
+# Convolutions / pooling (CNN-class user models; reference captures
+# arbitrary tf.nn graphs — cases c1/c5 are Keras CNN/dense stacks) -------
+def conv2d(x, filters, strides=1, padding='SAME'):
+    """NHWC conv with HWIO filters (the TF default layout the reference's
+    models use; also XLA's preferred TPU layout)."""
+    s = (strides, strides) if isinstance(strides, int) else tuple(strides)
+
+    def fn(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=s, padding=padding,
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+    return _sym(fn, x, filters)
+
+
+def bias_add(x, b):
+    return _sym(lambda x, b: x + b, x, b)
+
+
+def _pool(x, fn_init, reducer, size, strides, padding):
+    k = (size, size) if isinstance(size, int) else tuple(size)
+    s = k if strides is None else (
+        (strides, strides) if isinstance(strides, int) else tuple(strides))
+
+    def fn(x):
+        return jax.lax.reduce_window(
+            x, fn_init, reducer,
+            window_dimensions=(1,) + k + (1,),
+            window_strides=(1,) + s + (1,),
+            padding=padding)
+    return _sym(fn, x)
+
+
+def max_pool(x, size=2, strides=None, padding='VALID'):
+    return _pool(x, -jnp.inf, jax.lax.max, size, strides, padding)
+
+
+def avg_pool(x, size=2, strides=None, padding='VALID'):
+    k = (size, size) if isinstance(size, int) else tuple(size)
+    summed = _pool(x, 0.0, jax.lax.add, size, strides, padding)
+    return _sym(lambda v: v / (k[0] * k[1]), summed)
+
+
 # Control flow -------------------------------------------------------------
 def while_loop(cond_fn, body_fn, init):
     """Lifted ``lax.while_loop`` over symbolic carries.

@@ -8,6 +8,8 @@ c2 sparse-embedding matrix in test_sparse_embedding.py; this file adds:
   (reference cases/c4.py:24-34 — sigmoid iterated under tf.while_loop);
 - **c6**: a dynamic LSTM trained with Adam
   (reference cases/c6.py — LSTMCell + while_loop + matmul head);
+- **c1/c5 role**: a conv/pool CNN through the DSL image ops
+  (reference cases/c1.py, c5.py — Keras CNN/dense stacks);
 - **c10**: saver round-trip — checkpoints written under any distribution
   strategy restore into a FRESH unsharded session and into plain host
   arrays (reference cases/c10.py + the vanilla-TF restore proof in
@@ -206,6 +208,65 @@ def test_c6_lstm_parity(name, builder, c6_truth):
     for got, ref in zip(vals, c6_truth):
         assert np.allclose(got, ref, atol=10 * _tol(name)), \
             '%s: max err %g' % (name, np.abs(got - ref).max())
+
+
+# -- c1/c5 role: a CNN through the DSL conv/pool ops -----------------------
+
+def run_cnn(autodist, epochs=2):
+    rng = np.random.RandomState(7)
+    images = rng.rand(16, 16, 16, 3).astype(np.float32)
+    labels = rng.randint(0, 10, (16,)).astype(np.int32)
+    f1_0 = rng.uniform(-0.1, 0.1, (3, 3, 3, 8)).astype(np.float32)
+    f2_0 = rng.uniform(-0.1, 0.1, (3, 3, 8, 8)).astype(np.float32)
+    w0 = rng.uniform(-0.1, 0.1, (128, 10)).astype(np.float32)
+
+    with autodist.scope():
+        x = ad.placeholder(shape=[None, 16, 16, 3], dtype=np.float32,
+                           name='x')
+        y = ad.placeholder(shape=[None], dtype=np.int32, name='y')
+        F1 = ad.Variable(f1_0, name='F1')
+        b1 = ad.Variable(np.zeros(8, np.float32), name='b1')
+        F2 = ad.Variable(f2_0, name='F2')
+        b2 = ad.Variable(np.zeros(8, np.float32), name='b2')
+        W = ad.Variable(w0, name='W')
+        bo = ad.Variable(np.zeros(10, np.float32), name='bo')
+
+        h = ad.ops.relu(ad.ops.bias_add(ad.ops.conv2d(x, F1), b1))
+        h = ad.ops.max_pool(h, 2)                       # 16 -> 8
+        h = ad.ops.relu(ad.ops.bias_add(ad.ops.conv2d(h, F2), b2))
+        h = ad.ops.avg_pool(h, 2)                       # 8 -> 4
+        h = ad.ops.reshape(h, (-1, 128))
+        logits = ad.ops.matmul(h, W) + bo
+        loss = ad.ops.reduce_mean(
+            ad.ops.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+        train_op = ad.optimizers.SGD(0.1).minimize(
+            loss, [F1, b1, F2, b2, W, bo])
+        sess = autodist.create_distributed_session()
+        losses = []
+        for _ in range(epochs):
+            lv, _ = sess.run([loss, train_op], {x: images, y: labels})
+            losses.append(float(lv))
+        vals = sess.run([F1, b1, F2, b2, W, bo])
+    return losses, [np.asarray(v) for v in vals]
+
+
+@pytest.fixture(scope='module')
+def cnn_truth():
+    vals = run_cnn(_fresh(1, AllReduce))
+    from autodist_tpu import autodist as ad_mod
+    ad_mod._DEFAULT_AUTODIST.clear()
+    return vals
+
+
+@pytest.mark.parametrize('name,builder', STRATEGIES, ids=IDS)
+def test_cnn_parity(name, builder, cnn_truth):
+    losses_ref, vals_ref = cnn_truth
+    losses, vals = run_cnn(_fresh(8, builder))
+    for got, ref in zip(vals, vals_ref):
+        assert np.allclose(got, ref, atol=10 * _tol(name)), \
+            '%s: max err %g' % (name, np.abs(got - ref).max())
+    assert losses[-1] <= losses[0]
 
 
 # -- c10: saver round-trip into a fresh unsharded session ------------------
