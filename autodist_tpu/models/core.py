@@ -100,7 +100,14 @@ def _init_leaf(rng, d):
     if d.init == 'ones':
         return jnp.ones(d.shape, jnp.float32)
     if d.init == 'fan_in':
-        fan_in = d.shape[0] if len(d.shape) > 1 else max(d.shape[0], 1)
+        # fan-in = product of all non-output dims (for a dense (in, out)
+        # kernel that is `in`; for a conv HWIO kernel it is h*w*in)
+        if len(d.shape) > 1:
+            fan_in = 1
+            for s in d.shape[:-1]:
+                fan_in *= s
+        else:
+            fan_in = max(d.shape[0], 1)
         std = 1.0 / math.sqrt(fan_in)
         return jax.random.normal(rng, d.shape, jnp.float32) * std
     return jax.random.normal(rng, d.shape, jnp.float32) * d.scale

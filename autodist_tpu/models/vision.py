@@ -192,8 +192,13 @@ class VGG(Module):
     CFG16 = (64, 64, 'M', 128, 128, 'M', 256, 256, 256, 'M',
              512, 512, 512, 'M', 512, 512, 512, 'M')
 
-    def __init__(self, cfg=CFG16, num_classes=1000, dtype=jnp.float32):
+    def __init__(self, cfg=CFG16, num_classes=1000, dtype=jnp.float32,
+                 fc_spatial=7):
+        """``fc_spatial`` is the spatial size after the conv stack
+        (7 for CFG16 at 224px); the classic fixed-size fc head is sized
+        from it, so custom cfgs/resolutions must pass theirs."""
         self.cfg = cfg
+        self.fc_spatial = fc_spatial
         self.convs = []
         in_ch = 3
         for v in cfg:
@@ -202,7 +207,8 @@ class VGG(Module):
             self.convs.append(Conv(in_ch, v, 3, 1, use_bias=True,
                                    dtype=dtype))
             in_ch = v
-        self.fc1 = Dense(512 * 7 * 7, 4096, 'embed', 'mlp', dtype=dtype)
+        self.fc1 = Dense(in_ch * fc_spatial * fc_spatial, 4096,
+                         'embed', 'mlp', dtype=dtype)
         self.fc2 = Dense(4096, 4096, 'mlp', 'mlp', dtype=dtype)
         self.head = Dense(4096, num_classes, 'mlp', 'classes',
                           dtype=dtype)
@@ -227,6 +233,13 @@ class VGG(Module):
                 y = jax.nn.relu(
                     self.convs[ci].apply(params['conv_%02d' % ci], y))
                 ci += 1
+        if y.shape[1] != self.fc_spatial:
+            raise ValueError(
+                'VGG conv stack produced %dx%d spatial but the fc head '
+                'was sized for %dx%d; pass fc_spatial=%d for this '
+                'cfg/resolution' % (y.shape[1], y.shape[2],
+                                    self.fc_spatial, self.fc_spatial,
+                                    y.shape[1]))
         y = y.reshape(y.shape[0], -1)
         y = jax.nn.relu(self.fc1.apply(params['fc1'], y))
         y = jax.nn.relu(self.fc2.apply(params['fc2'], y))
@@ -413,6 +426,11 @@ class InceptionV3(Module):
         return d
 
     def apply(self, params, x):
+        if x.shape[1] < 75 or x.shape[2] < 75:
+            # below this the grid reductions hit zero spatial size and
+            # reductions over empty windows would silently produce NaN
+            raise ValueError('InceptionV3 needs inputs >= 75x75, got '
+                             '%dx%d' % (x.shape[1], x.shape[2]))
         y = x
         for i, m in enumerate(self.stem):
             y = m.apply(params['stem_%d' % i], y)

@@ -29,13 +29,15 @@ def build_model(name, tiny, dtype):
             'resnet152': lambda: vision.ResNet((2, 2), num_classes=10,
                                                dtype=dtype),
             'vgg16': lambda: vision.VGG(
-                (16, 'M', 32, 'M'), num_classes=10, dtype=dtype),
+                (16, 'M', 32, 'M'), num_classes=10, dtype=dtype,
+                fc_spatial=8),
             'densenet121': lambda: vision.DenseNet(
                 (2, 2), num_classes=10, dtype=dtype),
             'inception': lambda: vision.InceptionV3(num_classes=10,
                                                     dtype=dtype),
         }
-        return builders[name](), 32
+        # inception's grid reductions need >= 75px even in tiny mode
+        return builders[name](), (80 if name == 'inception' else 32)
     builders = {
         'resnet50': vision.ResNet.resnet50,
         'resnet101': vision.ResNet.resnet101,
