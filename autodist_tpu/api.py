@@ -324,6 +324,61 @@ class Trainer:
         batch = self.shard_batch(batch)
         return fn(state, batch)
 
+    # -- fit/evaluate conveniences (reference case c7's Model.fit role) ----
+    def fit(self, state, data, steps=None, eval_data=None, eval_every=0):
+        """Train over an iterable of batches (c7 ``Model.fit`` role).
+
+        Args:
+            state: TrainState from :meth:`init`.
+            data: iterable (or iterator) of batch dicts.
+            steps: stop after this many steps (None = exhaust ``data``).
+            eval_data: optional sequence of eval batches.
+            eval_every: run :meth:`evaluate` every N steps (0 = only at
+                the end when ``eval_data`` is given).
+
+        Returns:
+            (state, history) where history is a dict with 'loss' (one
+            entry per step) and, when evaluating, 'eval_loss' entries of
+            (step, loss).
+        """
+        history = {'loss': []}
+        if eval_data is not None:
+            history['eval_loss'] = []
+        it = iter(data)
+        n = 0
+        for batch in it:
+            state, metrics = self.step(state, batch)
+            history['loss'].append(float(metrics['loss']))
+            n += 1
+            if eval_data is not None and eval_every and \
+                    n % eval_every == 0:
+                history['eval_loss'].append(
+                    (n, self.evaluate(state, eval_data)))
+            if steps is not None and n >= steps:
+                break
+        if eval_data is not None and (not eval_every or
+                                      n % eval_every):
+            history['eval_loss'].append((n, self.evaluate(state,
+                                                          eval_data)))
+        return state, history
+
+    def evaluate(self, state, batches):
+        """Mean loss over batches without updating state (c7
+        ``Model.evaluate`` role)."""
+        if not hasattr(self, '_eval_cache'):
+            self._eval_cache = {}
+        total, count = 0.0, 0
+        for batch in batches:
+            key = self._step_key(batch)
+            if key not in self._eval_cache:
+                def eval_fn(params, batch):
+                    return self.loss_for(params, batch)
+                self._eval_cache[key] = jax.jit(eval_fn)
+            batch = self.shard_batch(batch)
+            total += float(self._eval_cache[key](state.params, batch))
+            count += 1
+        return total / max(count, 1)
+
     # -- fetch helpers (reference get-variable parity) ---------------------
     def get_params(self, state):
         """Gather params to host in logical (unsharded) layout."""

@@ -182,6 +182,23 @@ def test_ring_attention_grads_match_dense():
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
+def test_fit_and_evaluate(tiny_lm, batch):
+    """c7 role: Model.fit/evaluate over an iterable of batches."""
+    tr = Trainer(tiny_lm, optax.adam(1e-2), spec=ParallelSpec())
+    state = tr.init(jax.random.PRNGKey(0))
+    data = [batch] * 5
+    state, hist = tr.fit(state, data, eval_data=[batch], eval_every=2)
+    assert len(hist['loss']) == 5
+    assert hist['loss'][-1] < hist['loss'][0]
+    # eval at steps 2, 4 and the final partial interval (5)
+    assert [s for s, _ in hist['eval_loss']] == [2, 4, 5]
+    # eval loss is the loss of the CURRENT params (lower than step-1 train)
+    assert hist['eval_loss'][-1][1] < hist['loss'][0]
+    # steps= caps the iterator
+    state, hist2 = tr.fit(state, iter(data), steps=2)
+    assert len(hist2['loss']) == 2
+
+
 def test_trainer_get_params_logical_layout(tiny_lm, batch):
     tr = Trainer(tiny_lm, optax.sgd(0.1), spec=ParallelSpec(tp=2))
     state = tr.init(jax.random.PRNGKey(0))
