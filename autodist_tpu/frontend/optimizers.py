@@ -158,6 +158,71 @@ class Adamax(Optimizer):
             name, _capture=('Adamax', (learning_rate,), {}))
 
 
+class Nadam(Optimizer):
+    """Adam with Nesterov momentum (reference test matrix: nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.nadam(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon),
+            name, _capture=('Nadam', (learning_rate,),
+                            {'beta_1': beta_1, 'beta_2': beta_2}))
+
+
+def _ftrl(learning_rate, learning_rate_power, initial_accumulator_value,
+          l1, l2, beta):
+    """FTRL-proximal (TF keras Ftrl semantics); optax has no ftrl."""
+    import jax
+
+    def init_fn(params):
+        return jax.tree.map(
+            lambda p: (jnp.full_like(p, initial_accumulator_value),
+                       jnp.zeros_like(p)), params,
+            is_leaf=lambda x: hasattr(x, 'shape'))
+
+    def _leaf(grad, state, param):
+        n, z = state
+        n_new = n + grad * grad
+        p = -learning_rate_power
+        pow_old, pow_new = n ** p, n_new ** p
+        sigma = (pow_new - pow_old) / learning_rate
+        z_new = z + grad - sigma * param
+        denom = (beta + pow_new) / learning_rate + 2.0 * l2
+        w_new = jnp.where(
+            jnp.abs(z_new) <= l1, jnp.zeros_like(z_new),
+            -(z_new - jnp.sign(z_new) * l1) / denom)
+        return w_new - param, (n_new, z_new)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError('ftrl requires params')
+        flat_u, tree = jax.tree.flatten(updates)
+        flat_s = tree.flatten_up_to(state)
+        flat_p = jax.tree.leaves(params)
+        out = [_leaf(u, s, p) for u, s, p in zip(flat_u, flat_s, flat_p)]
+        return (tree.unflatten([o[0] for o in out]),
+                tree.unflatten([o[1] for o in out]))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference test matrix: ftrl); supports the l1
+    shrinkage that zeroes small weights."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_power=-0.5,
+                 initial_accumulator_value=0.1,
+                 l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, beta=0.0, name=None):
+        super().__init__(
+            _ftrl(learning_rate, learning_rate_power,
+                  initial_accumulator_value, l1_regularization_strength,
+                  l2_regularization_strength, beta),
+            name, _capture=('Ftrl', (learning_rate,),
+                            {'l1': l1_regularization_strength,
+                             'l2': l2_regularization_strength}))
+
+
 class LAMB(Optimizer):
     """Layer-wise adaptive optimizer used by the BERT-large benchmark."""
 

@@ -23,6 +23,10 @@ OPTIMIZER_CASES = [
     (opts.Adamax, {'learning_rate': 0.002}),
     (opts.LAMB, {'learning_rate': 0.001}),
     (opts.LAMB, {'learning_rate': 0.001, 'weight_decay': 0.01}),
+    (opts.Nadam, {'learning_rate': 0.001}),
+    (opts.Ftrl, {'learning_rate': 0.05}),
+    (opts.Ftrl, {'learning_rate': 0.05,
+                 'l1_regularization_strength': 0.01}),
 ]
 
 
