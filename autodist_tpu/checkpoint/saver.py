@@ -93,12 +93,53 @@ def load_pytree(path, like=None):
         manifest.get('step')
 
 
-class CheckpointManager:
-    """Step-numbered checkpoints with retention (keep latest k)."""
+def save_pytree_orbax(path, tree, step=None):
+    """Orbax (tensorstore) backend: sharded, async-flushed writes — the
+    production path for large multi-host states. Step metadata rides in
+    a sidecar (orbax's own metadata stores the tree structure)."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr.save(os.path.abspath(path),
+               jax.tree.map(jnp_or_np_asarray, tree))
+    ckptr.wait_until_finished()
+    with open(path + '.step', 'w') as f:
+        json.dump({'step': step}, f)
+    logging.info('Saved orbax checkpoint to %s', path)
+    return path
 
-    def __init__(self, directory, max_to_keep=3):
+
+def jnp_or_np_asarray(x):
+    return x if hasattr(x, 'dtype') else np.asarray(x)
+
+
+def load_pytree_orbax(path, like=None):
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    tree = ckptr.restore(os.path.abspath(path), target=like)
+    step = None
+    if os.path.exists(path + '.step'):
+        with open(path + '.step') as f:
+            step = json.load(f).get('step')
+    return tree, step
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (keep latest k).
+
+    ``backend='npy'`` (default) writes the self-contained
+    manifest + .npy layout; ``backend='orbax'`` delegates tensor IO to
+    orbax/tensorstore (sharded files, async flush) while keeping the
+    same directory/retention/latest-step contract.
+    """
+
+    def __init__(self, directory, max_to_keep=3, backend='npy'):
+        if backend not in ('npy', 'orbax'):
+            raise ValueError('backend must be npy or orbax: %r' % backend)
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self.backend = backend
         os.makedirs(directory, exist_ok=True)
 
     def _ckpt_path(self, step):
@@ -119,16 +160,23 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step, tree):
-        path = save_pytree(self._ckpt_path(step), tree, step=step)
+        save_fn = save_pytree_orbax if self.backend == 'orbax' \
+            else save_pytree
+        path = save_fn(self._ckpt_path(step), tree, step=step)
         for old in self.all_steps()[:-self.max_to_keep]:
             shutil.rmtree(self._ckpt_path(old))
+            sidecar = self._ckpt_path(old) + '.step'
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
         return path
 
     def restore(self, like=None, step=None):
         step = step if step is not None else self.latest_step()
         if step is None:
             return None, None
-        tree, _ = load_pytree(self._ckpt_path(step), like=like)
+        load_fn = load_pytree_orbax if self.backend == 'orbax' \
+            else load_pytree
+        tree, _ = load_fn(self._ckpt_path(step), like=like)
         return tree, step
 
 

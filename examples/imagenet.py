@@ -50,9 +50,33 @@ def build_model(name, tiny, dtype):
     return builders[name](dtype=dtype), hw
 
 
+def record_stream(args, hw):
+    """Native-DataLoader streaming when --data holds ADTR1 record files
+    (images.records + labels.records, written with
+    autodist_tpu.data.loader.write_records). The C++ reader thread
+    prefetches so host IO overlaps device steps; shuffle stays off to
+    keep the two files aligned record-for-record."""
+    data_dir = args.data or os.environ.get('SYS_DATA_PATH') or ''
+    img = os.path.join(data_dir, 'images.records') if data_dir else ''
+    lab = os.path.join(data_dir, 'labels.records') if data_dir else ''
+    if not (img and os.path.exists(img) and os.path.exists(lab)):
+        return None
+    from autodist_tpu.data.loader import DataLoader
+    images = DataLoader([img], args.batch, (hw, hw, 3), 'float32',
+                        shuffle=False)
+    labels = DataLoader([lab], args.batch, (), 'int32', shuffle=False)
+
+    def gen():
+        while True:
+            yield {'images': images.next_batch(),
+                   'labels': labels.next_batch()}
+    return gen()
+
+
 def load_batch(args, hw, num_classes):
     data_dir = args.data or os.environ.get('SYS_DATA_PATH') or ''
-    if data_dir and os.path.isdir(data_dir):
+    if data_dir and os.path.isdir(data_dir) and \
+            os.path.exists(os.path.join(data_dir, 'images.npy')):
         images = np.load(os.path.join(data_dir, 'images.npy'))
         labels = np.load(os.path.join(data_dir, 'labels.npy'))
         images = images[:args.batch].astype('f4')
@@ -103,9 +127,20 @@ def main():
         trainer = Trainer(model, opt, spec=ParallelSpec())
 
     state = trainer.init(jax.random.PRNGKey(0))
-    batch = load_batch(args, hw, num_classes)
-
-    state, loss, dt = _common.timed_steps(trainer, state, batch, args.steps)
+    stream = record_stream(args, hw)
+    if stream is not None:   # real data: stream fresh batches per step
+        import time
+        state, m = trainer.step(state, next(stream))   # compile+warmup
+        float(m['loss'])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = trainer.step(state, next(stream))
+        loss = float(m['loss'])
+        dt = time.perf_counter() - t0
+    else:
+        batch = load_batch(args, hw, num_classes)
+        state, loss, dt = _common.timed_steps(trainer, state, batch,
+                                              args.steps)
     n = len(jax.devices())
     print('%s: %.1f img/s (%.1f img/s/chip), loss=%.4f' %
           (args.model, args.steps * args.batch / dt,
