@@ -7,13 +7,16 @@ switches to ring attention (parallel/ring_attention.py). The reference
 has neither TP nor SP (SURVEY.md §2.3) — these are the TPU-native
 extension axes of the strategy space.
 """
+import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
-from autodist_tpu.const import AXIS_SEQUENCE
+from autodist_tpu.const import AXIS_DATA, AXIS_SEQUENCE
 from autodist_tpu.kernels import flash_attention as fa
 from autodist_tpu.models.core import Dense, Module, constrain
-from autodist_tpu.parallel.axes import (ctx_option, manual_axis,
-                                        unsharded_execution)
+from autodist_tpu.parallel.axes import (active_manual_axes, ctx_option,
+                                        current_mesh, live_mesh_axis,
+                                        manual_axis, unsharded_execution)
 from autodist_tpu.parallel.ring_attention import (local_flash_attention,
                                                   ring_attention)
 from autodist_tpu.parallel.ulysses import ulysses_attention
@@ -59,8 +62,51 @@ class MultiHeadAttention(Module):
             # device-local long-seq data: the Pallas flash kernel (never
             # materializes the [s, s] score matrix in HBM)
             o = fa.flash_attention(q, k, v, causal=self.causal)
+        elif self._tp_manual_shape(q.shape) is not None:
+            # dp/tp GSPMD mesh at long seq: attention is independent per
+            # (batch, head), so hop into a nested manual region over the
+            # data+model axes and run the flash kernel on local shards —
+            # GSPMD alone cannot partition an opaque pallas_call.
+            o = self._tp_manual_flash(q, k, v)
         else:
             o = local_flash_attention(q, k, v, causal=self.causal)
             o = constrain(o, ('batch', 'heads', 'seq', 'kv'))
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, h * d)
         return self.wo.apply(params['out'], o)
+
+    # -- nested-manual flash under dp/tp GSPMD -----------------------------
+    def _tp_manual_shape(self, shape):
+        """Per-shard [b, h, s, d] when the nested-manual flash path
+        applies, else None. Conditions: no manual region already active,
+        a mesh whose only size>1 axes are data and the heads axis, head
+        and batch dims divisible, and the per-shard shape past the
+        kernel crossover."""
+        if active_manual_axes():
+            return None
+        mesh = current_mesh()
+        if mesh is None:
+            return None
+        heads_axis = live_mesh_axis('heads')
+        for name, size in mesh.shape.items():
+            if size > 1 and name != AXIS_DATA and name != heads_axis:
+                return None
+        dp = mesh.shape.get(AXIS_DATA, 1)
+        tp = mesh.shape[heads_axis] if heads_axis else 1
+        if dp * tp <= 1 or shape[0] % dp or shape[1] % tp:
+            return None
+        local = (shape[0] // dp, shape[1] // tp, shape[2], shape[3])
+        return local if fa.preferred(local) else None
+
+    def _tp_manual_flash(self, q, k, v):
+        mesh = current_mesh()
+        heads_axis = live_mesh_axis('heads')
+        spec = P(AXIS_DATA if mesh.shape.get(AXIS_DATA, 1) > 1 else None,
+                 heads_axis)
+        names = {a for a in (AXIS_DATA, heads_axis)
+                 if a and mesh.shape.get(a, 1) > 1}
+        fn = jax.shard_map(
+            lambda q, k, v: fa.flash_attention(q, k, v,
+                                               causal=self.causal),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            axis_names=names, check_vma=False)
+        return fn(q, k, v)

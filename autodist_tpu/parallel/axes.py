@@ -204,6 +204,11 @@ def current_mesh():
     return _CTX.mesh
 
 
+def active_manual_axes():
+    """Mesh axes the current trace runs manually (shard_map), if any."""
+    return _CTX.manual_axes
+
+
 def unsharded_execution():
     """True when the current trace computes on purely device-local data:
     no mesh, a single-device mesh, or every size>1 mesh axis manual

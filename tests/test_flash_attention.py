@@ -75,6 +75,50 @@ def test_supports_and_preferred():
     assert fa.preferred((1, 1, 2048, 64))
 
 
+def test_tp_mesh_dispatches_via_nested_manual(monkeypatch):
+    """Under a dp/tp GSPMD mesh the module hops into a nested shard_map
+    so the kernel runs on local shards — and the numbers still match the
+    pure-DP run."""
+    import optax
+
+    import autodist_tpu.models.attention as attn_mod
+    from autodist_tpu.api import Trainer
+    from autodist_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from autodist_tpu.parallel.axes import ParallelSpec
+
+    calls = {'n': 0}
+    real = fa.flash_attention
+
+    def spy(*a, **kw):
+        calls['n'] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attn_mod.fa, 'flash_attention', spy)
+    monkeypatch.setattr(attn_mod.fa, 'MIN_KERNEL_SEQ', 16)
+
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=2)
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    batch = {'tokens': rng.randint(0, 256, (8, 32)),
+             'targets': rng.randint(0, 256, (8, 32))}
+
+    def losses(spec):
+        tr = Trainer(model, optax.adam(1e-2), spec=spec)
+        state = tr.init(jax.random.PRNGKey(0))
+        out = []
+        for _ in range(2):
+            state, m = tr.step(state, batch)
+            out.append(float(m['loss']))
+        return out
+
+    tp_losses = losses(ParallelSpec(tp=2))
+    assert calls['n'] > 0, 'nested-manual kernel path not taken'
+    monkeypatch.setattr(attn_mod.fa, 'MIN_KERNEL_SEQ', 10**9)
+    dp_losses = losses(ParallelSpec())
+    np.testing.assert_allclose(tp_losses, dp_losses, atol=3e-4)
+
+
 def test_module_dispatches_to_kernel(monkeypatch):
     """MultiHeadAttention routes to the kernel exactly when execution is
     device-local and the shape clears the crossover."""
