@@ -266,13 +266,48 @@ class Trainer:
         return ce + getattr(self.model, 'aux_loss_weight', 0.0) * aux
 
     def _build_step(self, batch_struct):
-        def step_fn(state, batch):
+        accum = max(1, int(getattr(self.spec, 'grad_accum', 1)))
+
+        def grads_of(params, batch):
             def loss_fn(p):
                 with sharding_ctx(self.mesh, self.rules):
                     return self.loss_for(p, batch)
             if self.spec.remat == 'full':
                 loss_fn = jax.checkpoint(loss_fn)
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return jax.value_and_grad(loss_fn)(params)
+
+        def step_fn(state, batch):
+            if accum > 1:
+                # split the leading (batch) dim into `accum` chunks and
+                # scan, averaging loss and grads — exact parity with the
+                # single-pass mean for equal chunks, at 1/accum the
+                # activation memory
+                def _chunk(x):
+                    if x.shape[0] % accum:
+                        raise ValueError(
+                            'grad_accum=%d does not divide batch dim %d'
+                            % (accum, x.shape[0]))
+                    return x.reshape((accum, x.shape[0] // accum)
+                                     + x.shape[1:])
+
+                chunked = jax.tree.map(_chunk, batch)
+
+                def body(acc, chunk):
+                    loss_c, grads_c = grads_of(state.params, chunk)
+                    acc_loss, acc_grads = acc
+                    return (acc_loss + loss_c,
+                            jax.tree.map(jnp.add, acc_grads, grads_c)), \
+                        None
+
+                zero = (jnp.zeros((), jnp.float32),
+                        jax.tree.map(
+                            lambda p: jnp.zeros(p.shape, jnp.float32),
+                            state.params))
+                (loss, grads), _ = jax.lax.scan(body, zero, chunked)
+                loss = loss / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
+            else:
+                loss, grads = grads_of(state.params, batch)
             updates, new_opt = self.optimizer.update(
                 grads, state.opt_state, state.params)
             new_params = jax.tree.map(

@@ -63,6 +63,7 @@ class ParallelSpec:
     remat: str = 'none'
     microbatches: int = 1          # pipeline microbatches (pp>1)
     sp_mode: str = 'ring'          # 'ring' | 'ulysses' (sp>1 attention)
+    grad_accum: int = 1            # gradient-accumulation chunks
     rules: list = field(default_factory=lambda: [list(r)
                                                  for r in DEFAULT_RULES])
 

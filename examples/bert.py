@@ -31,6 +31,7 @@ def main():
                    choices=['ring', 'ulysses'])
     p.add_argument('--zero', type=int, default=1)
     p.add_argument('--microbatches', type=int, default=1)
+    p.add_argument('--grad-accum', type=int, default=1)
     p.add_argument('--fp32', action='store_true')
     args = p.parse_args()
 
@@ -52,7 +53,8 @@ def main():
     opt = (optax.lamb if args.optimizer == 'lamb' else optax.adamw)(args.lr)
     spec = ParallelSpec(dp=args.dp, tp=args.tp, pp=args.pp, sp=args.sp,
                         sp_mode=args.sp_mode, zero=args.zero,
-                        microbatches=args.microbatches)
+                        microbatches=args.microbatches,
+                        grad_accum=args.grad_accum)
     trainer = Trainer(model, opt, spec=spec)
     state = trainer.init(jax.random.PRNGKey(0))
 

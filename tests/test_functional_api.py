@@ -182,6 +182,22 @@ def test_ring_attention_grads_match_dense():
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
+def test_grad_accum_matches_single_pass(tiny_lm, batch, dp_losses):
+    """Mean-of-chunk-means == single-pass mean for equal chunks, so
+    grad_accum must reproduce the plain DP numbers exactly (at 1/k the
+    activation memory)."""
+    losses = run_losses(tiny_lm, ParallelSpec(grad_accum=4), batch)
+    assert np.allclose(losses, dp_losses, atol=2e-4), (losses, dp_losses)
+
+
+def test_grad_accum_rejects_indivisible_batch(tiny_lm, batch):
+    tr = Trainer(tiny_lm, optax.adam(1e-2),
+                 spec=ParallelSpec(grad_accum=3))
+    state = tr.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match='grad_accum'):
+        tr.step(state, batch)   # batch dim 8 % 3 != 0
+
+
 def test_fit_and_evaluate(tiny_lm, batch):
     """c7 role: Model.fit/evaluate over an iterable of batches."""
     tr = Trainer(tiny_lm, optax.adam(1e-2), spec=ParallelSpec())
