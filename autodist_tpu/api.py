@@ -360,7 +360,8 @@ class Trainer:
         return fn(state, batch)
 
     # -- fit/evaluate conveniences (reference case c7's Model.fit role) ----
-    def fit(self, state, data, steps=None, eval_data=None, eval_every=0):
+    def fit(self, state, data, steps=None, eval_data=None, eval_every=0,
+            checkpoint_manager=None, save_every=0):
         """Train over an iterable of batches (c7 ``Model.fit`` role).
 
         Args:
@@ -370,6 +371,11 @@ class Trainer:
             eval_data: optional sequence of eval batches.
             eval_every: run :meth:`evaluate` every N steps (0 = only at
                 the end when ``eval_data`` is given).
+            checkpoint_manager: optional CheckpointManager; the FULL
+                state (params + optimizer slots + step) is saved every
+                ``save_every`` steps and at the end, enabling exact
+                resume via :meth:`restore_state`.
+            save_every: checkpoint cadence (0 = only at the end).
 
         Returns:
             (state, history) where history is a dict with 'loss' (one
@@ -389,12 +395,18 @@ class Trainer:
                     n % eval_every == 0:
                 history['eval_loss'].append(
                     (n, self.evaluate(state, eval_data)))
+            if checkpoint_manager is not None and save_every and \
+                    n % save_every == 0:
+                self.save_state(checkpoint_manager, state)
             if steps is not None and n >= steps:
                 break
         if eval_data is not None and (not eval_every or
                                       n % eval_every):
             history['eval_loss'].append((n, self.evaluate(state,
                                                           eval_data)))
+        if checkpoint_manager is not None and (not save_every or
+                                               n % save_every):
+            self.save_state(checkpoint_manager, state)
         return state, history
 
     def evaluate(self, state, batches):
@@ -413,6 +425,37 @@ class Trainer:
             total += float(self._eval_cache[key](state.params, batch))
             count += 1
         return total / max(count, 1)
+
+    # -- checkpoint/resume of the FULL training state ----------------------
+    def state_sharding(self, state):
+        """TrainState of NamedShardings matching how ``step`` places
+        this state on the mesh."""
+        param_sh = self._param_sharding_tree(state.params)
+        opt_sh = self._opt_sharding(state.opt_state, state.params,
+                                    param_sh)
+        return TrainState(params=param_sh, opt_state=opt_sh,
+                          step=NamedSharding(self.mesh, P()))
+
+    def save_state(self, manager, state):
+        """Checkpoint params + optimizer state + step for exact resume
+        (the reference's saver covers variables only; optimizer slots
+        ride along here so training continues bit-for-bit)."""
+        host = jax.tree.map(np.asarray, jax.device_get(state))
+        return manager.save(int(host.step), host)
+
+    def restore_state(self, manager, state_template, step=None):
+        """Restore a :meth:`save_state` checkpoint onto this trainer's
+        mesh (any mesh — the files are logical layout). Returns
+        ``state_template`` unchanged when no checkpoint exists."""
+        tree, got_step = manager.restore(like=jax.device_get(
+            state_template), step=step)
+        if tree is None:
+            return state_template, None
+        shardings = self.state_sharding(state_template)
+        state = jax.tree.map(
+            lambda x, sh: jax.device_put(jnp.asarray(x), sh),
+            tree, shardings)
+        return state, got_step
 
     # -- fetch helpers (reference get-variable parity) ---------------------
     def get_params(self, state):
