@@ -409,22 +409,35 @@ class Trainer:
             self.save_state(checkpoint_manager, state)
         return state, history
 
-    def evaluate(self, state, batches):
+    def evaluate(self, state, batches, metrics_fn=None):
         """Mean loss over batches without updating state (c7
-        ``Model.evaluate`` role)."""
+        ``Model.evaluate`` role).
+
+        With ``metrics_fn(params, batch) -> {name: scalar}`` (e.g. an
+        accuracy), returns ``{'loss': ..., **means of metrics}``
+        instead of the bare loss.
+        """
         if not hasattr(self, '_eval_cache'):
             self._eval_cache = {}
-        total, count = 0.0, 0
+        totals, count = {}, 0
         for batch in batches:
-            key = self._step_key(batch)
+            key = (self._step_key(batch), metrics_fn is not None)
+
             if key not in self._eval_cache:
                 def eval_fn(params, batch):
-                    return self.loss_for(params, batch)
+                    out = {'loss': self.loss_for(params, batch)}
+                    if metrics_fn is not None:
+                        out.update(metrics_fn(params, batch))
+                    return out
                 self._eval_cache[key] = jax.jit(eval_fn)
             batch = self.shard_batch(batch)
-            total += float(self._eval_cache[key](state.params, batch))
+            for name, val in self._eval_cache[key](state.params,
+                                                   batch).items():
+                totals[name] = totals.get(name, 0.0) + float(val)
             count += 1
-        return total / max(count, 1)
+        means = {name: val / max(count, 1)
+                 for name, val in totals.items()}
+        return means if metrics_fn is not None else means.get('loss', 0.0)
 
     # -- checkpoint/resume of the FULL training state ----------------------
     def state_sharding(self, state):

@@ -1,0 +1,39 @@
+"""The driver-facing entrypoints stay healthy: bench.py emits exactly
+one valid JSON line on the CPU smoke path, and __graft_entry__.entry()
+is jittable. (dryrun_multichip has its own driver run; re-running it
+here would double the suite's longest compile.)
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke_emits_one_json_line():
+    env = dict(os.environ,
+               JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=8')
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'bench.py')],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    for field in ('metric', 'value', 'unit', 'vs_baseline'):
+        assert field in rec, rec
+    assert rec['value'] > 0
+
+
+def test_graft_entry_forward():
+    import jax
+
+    import __graft_entry__ as g
+    fn, (params, tokens) = g.entry()
+    logits = jax.jit(fn)(params, tokens)
+    assert logits.shape[0] == tokens.shape[0]
+    assert np.isfinite(np.asarray(logits)).all()

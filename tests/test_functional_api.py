@@ -213,6 +213,13 @@ def test_fit_and_evaluate(tiny_lm, batch):
     # steps= caps the iterator
     state, hist2 = tr.fit(state, iter(data), steps=2)
     assert len(hist2['loss']) == 2
+    # evaluate with custom metrics returns a dict of means
+    def acc(params, b):
+        logits = tiny_lm.apply(params, jnp.asarray(b['tokens']))
+        hit = jnp.argmax(logits, -1) == jnp.asarray(b['targets'])
+        return {'accuracy': jnp.mean(hit.astype(jnp.float32))}
+    out = tr.evaluate(state, [batch], metrics_fn=acc)
+    assert set(out) == {'loss', 'accuracy'} and 0 <= out['accuracy'] <= 1
 
 
 def test_trainer_get_params_logical_layout(tiny_lm, batch):
