@@ -421,7 +421,9 @@ class Trainer:
             self._eval_cache = {}
         totals, count = {}, 0
         for batch in batches:
-            key = (self._step_key(batch), metrics_fn is not None)
+            # key by the metrics_fn itself: different fns with the same
+            # batch signature must not share a compiled evaluator
+            key = (self._step_key(batch), metrics_fn)
 
             if key not in self._eval_cache:
                 def eval_fn(params, batch):

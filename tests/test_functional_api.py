@@ -221,6 +221,13 @@ def test_fit_and_evaluate(tiny_lm, batch):
     out = tr.evaluate(state, [batch], metrics_fn=acc)
     assert set(out) == {'loss', 'accuracy'} and 0 <= out['accuracy'] <= 1
 
+    def always_one(params, b):
+        return {'one': jnp.ones(())}
+    # a different metrics_fn on the same batch signature must not reuse
+    # the previous compiled evaluator
+    out2 = tr.evaluate(state, [batch], metrics_fn=always_one)
+    assert set(out2) == {'loss', 'one'} and out2['one'] == 1.0
+
 
 def test_trainer_get_params_logical_layout(tiny_lm, batch):
     tr = Trainer(tiny_lm, optax.sgd(0.1), spec=ParallelSpec(tp=2))
