@@ -52,6 +52,14 @@ def test_uneven_blocks_and_scale():
     want = local_flash_attention(q, k, v, causal=True, sm_scale=0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+    # backward at the smallest (8-row) blocks too
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+    g1 = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(local_flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
 
 
 def test_long_seq_asymmetric_blocks():
