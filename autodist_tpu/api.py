@@ -415,10 +415,15 @@ class Trainer:
 
         With ``metrics_fn(params, batch) -> {name: scalar}`` (e.g. an
         accuracy), returns ``{'loss': ..., **means of metrics}``
-        instead of the bare loss.
+        instead of the bare loss. Pass a STABLE function object — the
+        compiled evaluator is cached per (batch signature, metrics_fn),
+        so a fresh lambda per call recompiles (the cache is bounded, so
+        this leaks time, not memory).
         """
         if not hasattr(self, '_eval_cache'):
             self._eval_cache = {}
+        if len(self._eval_cache) > 16:   # bound churn from unstable fns
+            self._eval_cache.clear()
         totals, count = {}, 0
         for batch in batches:
             # key by the metrics_fn itself: different fns with the same

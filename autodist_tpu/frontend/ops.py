@@ -181,28 +181,42 @@ def bias_add(x, b):
     return _sym(lambda x, b: x + b, x, b)
 
 
-def _pool(x, fn_init, reducer, size, strides, padding):
+def _pool_dims(size, strides):
     k = (size, size) if isinstance(size, int) else tuple(size)
     s = k if strides is None else (
         (strides, strides) if isinstance(strides, int) else tuple(strides))
+    return k, s
+
+
+def max_pool(x, size=2, strides=None, padding='VALID'):
+    k, s = _pool_dims(size, strides)
 
     def fn(x):
         return jax.lax.reduce_window(
-            x, fn_init, reducer,
+            x, -jnp.inf, jax.lax.max,
             window_dimensions=(1,) + k + (1,),
             window_strides=(1,) + s + (1,),
             padding=padding)
     return _sym(fn, x)
 
 
-def max_pool(x, size=2, strides=None, padding='VALID'):
-    return _pool(x, -jnp.inf, jax.lax.max, size, strides, padding)
-
-
 def avg_pool(x, size=2, strides=None, padding='VALID'):
-    k = (size, size) if isinstance(size, int) else tuple(size)
-    summed = _pool(x, 0.0, jax.lax.add, size, strides, padding)
-    return _sym(lambda v: v / (k[0] * k[1]), summed)
+    k, s = _pool_dims(size, strides)
+
+    def fn(x):
+        dims, strides_ = (1,) + k + (1,), (1,) + s + (1,)
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window_dimensions=dims,
+            window_strides=strides_, padding=padding)
+        if padding == 'VALID':
+            return summed / (k[0] * k[1])
+        # SAME: TF semantics divide by the count of VALID cells in each
+        # window (padded cells excluded), not the full window size
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, window_dimensions=dims,
+            window_strides=strides_, padding=padding)
+        return summed / counts
+    return _sym(fn, x)
 
 
 # Control flow -------------------------------------------------------------

@@ -288,6 +288,11 @@ class Session:
                                                 split_flags)
         fn = self._cache[key]
 
+        # a run is a training step only if it executes an optimizer
+        # update; fetch-only runs (variable reads, eval) must not count
+        # against the staleness window or push deltas
+        is_train = any(isinstance(f, fe.ApplyGradients) for f in norm)
+
         pulled = None
         if self._loose:
             # bounded-staleness window (reference token queues of size s,
@@ -295,7 +300,7 @@ class Session:
             # every worker must have completed >= s - staleness steps.
             # sync=False vars are unconditional no-wait (ps_strategy.py:
             # 30-35); any sync var imposes its (tightest) bound.
-            if self._plan.gate_enabled:
+            if is_train and self._plan.gate_enabled:
                 self._coord.staleness_gate(
                     self._step_count + 1, self._plan.gate_staleness,
                     self._num_workers, prefix=self._key('step/'))
@@ -329,11 +334,13 @@ class Session:
                 jax.profiler.stop_trace()
                 logging.info('Profiler trace written to %s',
                              options.trace_dir)
-        self._step_count += 1
-        if self._loose:
-            self._push_ps_deltas(pulled)
-            self._coord.publish_step(self._worker_name, self._step_count,
-                                     prefix=self._key('step/'))
+        if is_train:
+            self._step_count += 1
+            if self._loose:
+                self._push_ps_deltas(pulled)
+                self._coord.publish_step(self._worker_name,
+                                         self._step_count,
+                                         prefix=self._key('step/'))
 
         split_sizes = {v.shape[0] // self._plan.local_replicas
                        for v, s in zip(feed_vals, split_flags) if s}

@@ -1,0 +1,29 @@
+"""Honor JAX platform env vars on images whose sitecustomize pins them.
+
+Some environments register a PJRT plugin and pin ``JAX_PLATFORMS`` at
+interpreter startup, silently ignoring the standard
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
+incantation; ``jax.config.update`` after import is the reliable
+override. Shared by bench.py, examples/_common.py, and any user script
+that wants the documented env vars to actually work.
+"""
+import os
+import re
+
+
+def apply_jax_env_overrides():
+    import jax
+
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        try:
+            jax.config.update('jax_platforms', plat)
+        except RuntimeError:
+            pass   # backend already initialized
+    m = re.search(r'xla_force_host_platform_device_count=(\d+)',
+                  os.environ.get('XLA_FLAGS', ''))
+    if m:
+        try:
+            jax.config.update('jax_num_cpu_devices', int(m.group(1)))
+        except RuntimeError:
+            pass

@@ -186,33 +186,11 @@ def bench_longctx(steps):
     return batch_size * seq * steps / dt
 
 
-def _apply_jax_env_overrides():
-    """Honor JAX_PLATFORMS / --xla_force_host_platform_device_count even
-    on images whose sitecustomize pins the platform (same workaround as
-    tests/conftest.py and examples/_common.py)."""
-    import os
-    import re
-
-    import jax
-    plat = os.environ.get('JAX_PLATFORMS')
-    if plat:
-        try:
-            jax.config.update('jax_platforms', plat)
-        except RuntimeError:
-            pass   # backend already initialized
-    m = re.search(r'xla_force_host_platform_device_count=(\d+)',
-                  os.environ.get('XLA_FLAGS', ''))
-    if m:
-        try:
-            jax.config.update('jax_num_cpu_devices', int(m.group(1)))
-        except RuntimeError:
-            pass
-
-
 def main():
     import jax
 
-    _apply_jax_env_overrides()
+    from autodist_tpu.utils.jax_env import apply_jax_env_overrides
+    apply_jax_env_overrides()
     n = max(1, len(jax.devices()))
     dev = jax.devices()[0]
     on_tpu = dev.platform == 'tpu'

@@ -8,25 +8,13 @@ override (same workaround as tests/conftest.py). Importing this module
 makes the documented incantation work for the examples.
 """
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from autodist_tpu.utils.jax_env import apply_jax_env_overrides  # noqa: E402
 
-def _apply_jax_env_overrides():
-    import jax
-
-    plat = os.environ.get('JAX_PLATFORMS')
-    if plat:
-        jax.config.update('jax_platforms', plat)
-    m = re.search(r'xla_force_host_platform_device_count=(\d+)',
-                  os.environ.get('XLA_FLAGS', ''))
-    if m:
-        jax.config.update('jax_num_cpu_devices', int(m.group(1)))
-
-
-_apply_jax_env_overrides()
+apply_jax_env_overrides()
 
 
 def timed_steps(trainer, state, batch, steps):

@@ -76,6 +76,17 @@ def test_c0_numeric_parity(name, builder):
     assert loss_val > 0
 
 
+def test_fetch_only_runs_do_not_count_steps():
+    """step_count tracks optimizer steps only: fetch-only runs (variable
+    reads) must not advance it — in multi-process loose mode the counter
+    feeds the bounded-staleness gate, so counting eval-only runs would
+    let fast workers overrun the staleness bound."""
+    autodist = ad.AutoDist(resource_info=resource_info(),
+                           strategy_builder=AllReduce())
+    run_linear_regression(autodist)   # one train run + fetch-only runs
+    assert autodist._session.step_count == 1
+
+
 def test_uneven_replica_count():
     """1000 examples over 7 replicas: feed not divisible -> replicated
     feeds, gradient identical to single-device run."""

@@ -88,6 +88,7 @@ def test_checkpoint_manager_retention(tmp_path):
 def test_checkpoint_manager_orbax_backend(tmp_path):
     """Same manager contract (retention, latest-step restore) with
     tensor IO delegated to orbax/tensorstore."""
+    pytest.importorskip('orbax.checkpoint')
     mgr = CheckpointManager(str(tmp_path / 'ckpts'), max_to_keep=2,
                             backend='orbax')
     for s in (1, 2, 3):

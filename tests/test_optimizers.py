@@ -65,3 +65,18 @@ def test_new_optimizers_train_via_session(opt_name, kwargs):
     losses = [float(sess.run([loss, train_op], {x: xs, y: ys})[0])
               for _ in range(15)]
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_avg_pool_same_excludes_padding():
+    """TF avg_pool SAME semantics: border windows divide by the count of
+    valid cells, not the full window size."""
+    from autodist_tpu.frontend import graph as fe
+    from autodist_tpu.frontend import ops
+    x = np.arange(9, dtype=np.float32).reshape(1, 3, 3, 1)
+    with fe.Graph():
+        node = ops.avg_pool(ops.constant(x), size=2, strides=2,
+                            padding='SAME')
+        got = np.asarray(fe.evaluate(node, fe.Env({}, {})))
+    # windows: [[0,1,3,4]/4, [2,5]/2], [[6,7]/2, [8]/1]
+    want = np.array([[[2.0], [3.5]], [[6.5], [8.0]]], np.float32)[None]
+    np.testing.assert_allclose(got, want)
