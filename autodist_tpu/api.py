@@ -432,9 +432,13 @@ class Trainer:
 
             if key not in self._eval_cache:
                 def eval_fn(params, batch):
-                    out = {'loss': self.loss_for(params, batch)}
-                    if metrics_fn is not None:
-                        out.update(metrics_fn(params, batch))
+                    # same sharding context as step: constrain() hints
+                    # and sharding-aware module paths (e.g. the sharded
+                    # embedding lookup) stay active during eval
+                    with sharding_ctx(self.mesh, self.rules):
+                        out = {'loss': self.loss_for(params, batch)}
+                        if metrics_fn is not None:
+                            out.update(metrics_fn(params, batch))
                     return out
                 self._eval_cache[key] = jax.jit(eval_fn)
             batch = self.shard_batch(batch)
@@ -459,16 +463,39 @@ class Trainer:
     def save_state(self, manager, state):
         """Checkpoint params + optimizer state + step for exact resume
         (the reference's saver covers variables only; optimizer slots
-        ride along here so training continues bit-for-bit)."""
-        host = jax.tree.map(np.asarray, jax.device_get(state))
-        return manager.save(int(host.step), host)
+        ride along here so training continues bit-for-bit).
+
+        Multi-host: the orbax backend receives the live (sharded) arrays
+        and writes per-host shards itself; the npy backend gathers
+        non-addressable leaves across processes first.
+        """
+        step = int(jax.device_get(state.step))
+        if getattr(manager, 'backend', 'npy') == 'orbax':
+            return manager.save(step, state)
+
+        def to_host(x):
+            if hasattr(x, 'is_fully_addressable') and \
+                    not x.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                x = multihost_utils.process_allgather(x, tiled=True)
+            return np.asarray(jax.device_get(x))
+        host = jax.tree.map(to_host, state)   # collective: all processes
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return None   # one writer for the self-contained npy layout
+        return manager.save(step, host)
 
     def restore_state(self, manager, state_template, step=None):
         """Restore a :meth:`save_state` checkpoint onto this trainer's
         mesh (any mesh — the files are logical layout). Returns
         ``state_template`` unchanged when no checkpoint exists."""
-        tree, got_step = manager.restore(like=jax.device_get(
-            state_template), step=step)
+        # shape/dtype skeleton, not device_get: the template may span
+        # non-addressable devices in multi-host runs
+        like = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           getattr(x, 'dtype',
+                                                   jnp.float32)),
+            state_template)
+        tree, got_step = manager.restore(like=like, step=step)
         if tree is None:
             return state_template, None
         shardings = self.state_sharding(state_template)

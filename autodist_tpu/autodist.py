@@ -185,8 +185,8 @@ class AutoDist:
         # recognizable (Debian 127.0.1.1-style hostname resolution).
         chief_hosts_service = IS_AUTODIST_CHIEF and (
             host == self._resource_spec.chief or is_local_address(host))
+        all_local = all(is_local_address(n) for n in nodes)
         if chief_hosts_service:
-            all_local = all(is_local_address(n) for n in nodes)
             bind = '127.0.0.1' if all_local else '0.0.0.0'
             self._coord_proc = coord_client.ensure_service(
                 int(port), bind=bind)
@@ -198,13 +198,22 @@ class AutoDist:
                 # owns it — the chief may finish while workers still need
                 # it, so it must not tear it down here.
                 atexit.register(self._coord_proc.terminate)
-        self._coord = coord_client.connect_with_retry((host, int(port)))
-        if self._externally_launched:
-            # All processes started together: clear any stale strategy
-            # keys a reused service may hold BEFORE anyone waits on them.
-            # The barrier guarantees no worker reads until the chief's
-            # deletes have landed. (ssh mode skips this: workers are
-            # launched later, with the strategy id in their env.)
+        # all-local runs bind the service to loopback (ADVICE r1: don't
+        # expose an unauthenticated service on the NIC), so every process
+        # must also CONNECT via loopback even when the spec names the
+        # node by its NIC IP
+        connect_host = '127.0.0.1' if all_local else host
+        self._coord = coord_client.connect_with_retry(
+            (connect_host, int(port)))
+        if self._externally_launched and not ENV.AUTODIST_STRATEGY_ID.val:
+            # Co-started processes (launch_cli / pod) exchange the
+            # strategy through coord-service keys: clear any stale keys a
+            # reused service may hold BEFORE anyone waits on them; the
+            # barrier guarantees no worker reads until the chief's
+            # deletes have landed. ssh-launched workers carry
+            # AUTODIST_STRATEGY_ID and never touch these keys — and the
+            # ssh chief (which launches them only later) is not a party,
+            # so they must NOT join this barrier.
             ns = ENV.AUTODIST_RUN_ID.val
             if IS_AUTODIST_CHIEF:
                 self._coord.delete('strategy/%s/id' % ns)

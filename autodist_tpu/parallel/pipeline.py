@@ -43,6 +43,14 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
         ``(out, aux)``: [batch, ...] final activations and the scalar aux
         loss (mean over microbatches, summed over all stages' layers),
         both replicated over the pipe axis.
+
+    MoE note: under pipelining the router's balance statistics are
+    computed per MICROBATCH (each microbatch is a routing group, the
+    GShard grouping — same principle as per-seq-shard groups under SP),
+    so for microbatches > 1 the aux term is the mean of per-group losses
+    rather than one full-batch statistic. The two coincide at
+    microbatches=1 (pinned by test_moe_aux_loss_kept_under_pipelining);
+    beyond that the objective is the grouped one, by design.
     """
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)

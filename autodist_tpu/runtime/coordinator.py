@@ -176,6 +176,10 @@ def launch_cli(argv=None):
         all_local = all(is_local_address(n) for n in nodes)
         service_proc = coord_client.ensure_service(
             int(cs_port), bind='127.0.0.1' if all_local else '0.0.0.0')
+        if all_local:
+            # bound to loopback -> children must connect via loopback,
+            # even when the spec names this host by its NIC IP
+            coord_service = '127.0.0.1:%s' % cs_port
     import uuid
     run_id = uuid.uuid4().hex[:12]
     procs = []
