@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from autodist_tpu.const import AXIS_PIPELINE, AXIS_SEQUENCE
 from autodist_tpu.models.attention import MultiHeadAttention
@@ -29,8 +30,24 @@ class TransformerConfig:
     causal: bool = True
     tied_embeddings: bool = True
     dtype: object = jnp.bfloat16
-    remat: bool = False          # checkpoint each block
+    # remat: False = none; True = checkpoint each block (recompute the
+    # whole block in backward); 'save_attn' = checkpoint each block but
+    # SAVE the post-attention residual, so backward recomputes only the
+    # LN2+MLP half at one extra [b,s,d] save per layer. On v5e BERT
+    # bench shapes the two are perf-equal (step time is dominated
+    # elsewhere); 'save_attn' matters when attention is the expensive
+    # recompute (long sequences without the flash kernel).
+    remat: object = False
     scan_layers: bool = True     # stack blocks + lax.scan (1 compile/block)
+    # Chunked cross-entropy: target rows (batch*seq positions) per chunk
+    # of the lm-head + softmax computation. 0 = off (materialize full
+    # [b, s, vocab] fp32 logits). On, the loss scans over sequence
+    # chunks with jax.checkpoint, so peak memory holds one
+    # [b, s/n, vocab] slab instead of the whole thing. A memory
+    # feature, not a speed feature: at BERT-large bench shapes it frees
+    # ~8 GB (batch 768 compiles where 640 OOMed before) at unchanged
+    # tokens/s; it is what makes big-vocab / long-seq losses fit.
+    loss_chunk: int = 0
     moe_experts: int = 0         # >0: MoE MLP with this many experts
     moe_top_k: int = 2
     moe_aux_coef: float = 0.01   # load-balance loss weight
@@ -86,6 +103,8 @@ class Block(Module):
     def apply(self, params, x):
         x = x + self.attn.apply(params['attn'],
                                 self.ln1.apply(params['ln1'], x))
+        # named so remat='save_attn' can keep it while recomputing the rest
+        x = checkpoint_name(x, 'attn_out')
         h = self.mlp.apply(params['mlp'],
                            self.ln2.apply(params['ln2'], x))
         aux = jnp.zeros((), jnp.float32)
@@ -135,6 +154,21 @@ class TransformerLM(Module):
     def apply_with_aux(self, params, tokens):
         """Returns (logits, aux) where aux is the summed MoE router
         load-balance loss (0.0 for dense configs)."""
+        x, aux_total = self.hidden_with_aux(params, tokens)
+        logits = self._head_logits(params, x)
+        return constrain(logits.astype(jnp.float32),
+                         ('batch', 'seq', 'vocab')), aux_total
+
+    def _head_logits(self, params, x):
+        """LM-head logits (model dtype) for hidden states of any
+        leading shape (..., dim)."""
+        if self.cfg.tied_embeddings:
+            return self.embed.attend(params['embed'], x)
+        return self.lm_head.apply(params['lm_head'], x)
+
+    def hidden_with_aux(self, params, tokens):
+        """Final hidden states (post ln_f) and the MoE aux loss —
+        everything except the lm-head, so losses can chunk the head."""
         cfg = self.cfg
         b, s = tokens.shape
         x = self.embed.apply(params['embed'], tokens)
@@ -148,7 +182,15 @@ class TransformerLM(Module):
         x = constrain(x, ('batch', 'seq', 'embed'))
 
         block_fn = self.block.apply
-        if cfg.remat:
+        if isinstance(cfg.remat, str) and cfg.remat != 'save_attn':
+            raise ValueError('unknown remat mode %r (expected False, '
+                             'True, or \'save_attn\')' % (cfg.remat,))
+        if cfg.remat == 'save_attn':
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    'attn_out'))
+        elif cfg.remat:
             block_fn = jax.checkpoint(block_fn)
         aux_total = jnp.zeros((), jnp.float32)
         pipe_axis = manual_axis(AXIS_PIPELINE)
@@ -173,12 +215,7 @@ class TransformerLM(Module):
                 x, a = block_fn(params['block_%03d' % i], x)
                 aux_total = aux_total + a
         x = self.ln_f.apply(params['ln_f'], x)
-        if cfg.tied_embeddings:
-            logits = self.embed.attend(params['embed'], x)
-        else:
-            logits = self.lm_head.apply(params['lm_head'], x)
-        return constrain(logits.astype(jnp.float32),
-                         ('batch', 'seq', 'vocab')), aux_total
+        return x, aux_total
 
     def per_token_loss(self, params, batch):
         return self.per_token_loss_with_aux(params, batch)[0]
@@ -195,14 +232,48 @@ class TransformerLM(Module):
         inside shard_map over local seq shards and the trainer reduces.
         Under SP, MoE routing groups are the local seq shards (GShard
         grouping), so capacity/dropping is per-shard."""
-        logits, aux = self.apply_with_aux(params, batch['tokens'])
         targets = batch['targets']
+        x, aux = self.hidden_with_aux(params, batch['tokens'])
+        b, s = targets.shape
+        n = self._ce_chunks(s, b * s)
+        if n > 1:
+            # Chunked CE: scan over sequence chunks; jax.checkpoint means
+            # backward recomputes each chunk's logits instead of saving
+            # an [b, s, vocab] residual. Chunking the SEQ dim (not
+            # flattened rows) keeps the batch dim intact, so DP sharding
+            # propagates through the reshape without communication.
+            d = x.shape[-1]
+            xs = x.reshape(b, n, s // n, d).swapaxes(0, 1)
+            ts = targets.reshape(b, n, s // n).swapaxes(0, 1)
+            ckpt = jax.checkpoint(self._chunk_nll)
+            _, nll = jax.lax.scan(
+                lambda c, inp: (c, ckpt(params, *inp)), None, (xs, ts))
+            nll = nll.swapaxes(0, 1).reshape(b, s)
+        else:
+            nll = self._chunk_nll(params, x, targets)
+        return nll, aux
+
+    def _chunk_nll(self, params, x, targets):
+        logits = constrain(self._head_logits(params, x).astype(jnp.float32),
+                           ('batch', 'seq', 'vocab'))
         logz = jax.nn.logsumexp(logits, axis=-1)
         # one-hot contraction, not take_along_axis: partitions cleanly
         # when the vocab dim is tensor-sharded
         gold = jnp.sum(logits * jax.nn.one_hot(targets, logits.shape[-1],
                                                dtype=logits.dtype), axis=-1)
-        return logz - gold, aux
+        return logz - gold
+
+    def _ce_chunks(self, s, rows):
+        """Number of sequence chunks for chunked CE: the largest chunk
+        count that divides ``s`` while keeping >= loss_chunk rows per
+        chunk (0 or rows <= loss_chunk -> 1 = unchunked)."""
+        chunk = self.cfg.loss_chunk
+        if not chunk or rows <= chunk:
+            return 1
+        n = max(1, min(s, rows // chunk))
+        while s % n:
+            n -= 1
+        return n
 
     def loss(self, params, batch):
         """Mean token cross-entropy (+ MoE balance loss), optional mask."""
