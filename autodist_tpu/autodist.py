@@ -330,15 +330,25 @@ class AutoDist:
         def wrapper(*args, **kwargs):
             key = id(fn)
             if key not in self._fn_cache:
-                if self._fn_cache:
-                    raise NotImplementedError(
-                        "AutoDist currently only stably supports one "
-                        "'autodist.function' across the scope.")
                 self._fn_cache[key] = self._build_fn(fn, *args, **kwargs)
             return self._fn_cache[key](*args, **kwargs)
         return wrapper
 
     def _build_fn(self, fn, *args, **kwargs):
+        # Later functions (session already live) extend the SAME graph and
+        # share the session; the strategy was built from the variables seen
+        # at first build, so a later trace may reuse variables but not
+        # introduce new ones (the strategy has no node_config for them).
+        # Snapshot FIRST (before placeholder creation) so a rejected trace
+        # rolls back completely — orphan nodes would trip the mutation
+        # guard and orphan variables break var-state iteration.
+        graph = self._original_graph_item.graph
+        extending = self._session is not None
+        if extending:
+            nodes_before = len(graph.nodes)
+            vars_before = set(graph.variables)
+            pairs_before = dict(graph.grad_target_pairs)
+            opts_before = len(graph.optimizers)
         ph_index = {}
         args_ph, kwargs_ph = [], {}
         for i, a in enumerate(args):
@@ -357,9 +367,25 @@ class AutoDist:
                 kwargs_ph[k] = ph
             else:
                 kwargs_ph[k] = v
-        with self._original_graph_item.graph:
+        with graph:
             fetches = fn(*args_ph, **kwargs_ph)
-        session = self.create_distributed_session()
+        if extending:
+            new_vars = set(graph.variables) - vars_before
+            if new_vars:
+                del graph.nodes[nodes_before:]
+                for name in new_vars:
+                    del graph.variables[name]
+                graph.grad_target_pairs = pairs_before
+                del graph.optimizers[opts_before:]
+                raise ValueError(
+                    "a later 'autodist.function' created new variables %s "
+                    "after the strategy was built; create all variables "
+                    "under the first traced function (or one scope) so "
+                    "the strategy covers them" % sorted(new_vars))
+            session = self._session
+            session.refresh_mutation_guard()
+        else:
+            session = self.create_distributed_session()
 
         def run_fn(*args, **kwargs):
             feed = {}

@@ -110,6 +110,49 @@ class Session:
         return sum(1 for n in self._graph_item.graph.nodes
                    if not isinstance(n, fe.VariableRead))
 
+    def refresh_mutation_guard(self):
+        """Re-baseline the mutation guard after a SANCTIONED graph
+        extension — a later ``autodist.function`` trace adds nodes
+        through the framework itself, which is not the user-mutation
+        hazard the guard exists to catch. Optimizer slot state is
+        refreshed too: the extension may have traced a train op whose
+        optimizer the session had not seen at build time."""
+        self._built_node_count = self._user_node_count()
+        if self._refresh_opt_state():
+            # compiled steps close over the opt-state pytree STRUCTURE;
+            # a grown structure invalidates them (they would unzip stale
+            # in_specs against the new state)
+            self._cache.clear()
+
+    def _refresh_opt_state(self):
+        """Init + place optimizer slot state {uid: {var name: leaf
+        state}} for any (optimizer, var) pair in the graph not already
+        covered. One optimizer may appear in several ApplyGradients
+        nodes — the variable sets merge rather than keeping only the
+        first node's. Newly seen optimizers start with fresh slots.
+        Returns True when anything was added."""
+        added = False
+        opt_vars = {}   # uid -> (optimizer, {var name: Variable})
+        for node in self._graph_item.graph.nodes:
+            if isinstance(node, fe.ApplyGradients):
+                opt = node.optimizer
+                _, seen = opt_vars.setdefault(opt.uid, (opt, {}))
+                for _, v in node.grads_and_vars:
+                    seen[v.name] = v
+        for uid, (opt, seen) in opt_vars.items():
+            have = self._opt_state.get(uid, {})
+            missing = [v for name, v in seen.items() if name not in have]
+            if not missing:
+                continue
+            host_vals = {v.name: np.asarray(v.init_value)
+                         for v in missing}
+            slots = opt.init_slot_state(missing, host_vals)
+            state = self._opt_state.setdefault(uid, {})
+            for vname, leafstate in slots.items():
+                state[vname] = self._place_slots(vname, leafstate)
+                added = True
+        return added
+
     def _key(self, suffix):
         return '%s/%s' % (self._ns, suffix)
 
@@ -185,25 +228,8 @@ class Session:
             self._var_state[name] = self._put(
                 plan.pad_host(name, jnp.asarray(var.init_value)),
                 plan.var_sharding(name))
-        # per-optimizer slot state {uid: {var name: optax leaf state}};
-        # one optimizer may appear in several ApplyGradients nodes — merge
-        # the variable sets rather than keeping only the first node's.
-        opt_vars = {}   # uid -> (optimizer, {var name: Variable})
-        for node in self._graph_item.graph.nodes:
-            if isinstance(node, fe.ApplyGradients):
-                opt = node.optimizer
-                _, seen = opt_vars.setdefault(opt.uid, (opt, {}))
-                for _, v in node.grads_and_vars:
-                    seen[v.name] = v
         self._opt_state = {}
-        for uid, (opt, seen) in opt_vars.items():
-            variables = list(seen.values())
-            host_vals = {v.name: np.asarray(v.init_value)
-                         for v in variables}
-            slots = opt.init_slot_state(variables, host_vals)
-            self._opt_state[uid] = {
-                vname: self._place_slots(vname, leafstate)
-                for vname, leafstate in slots.items()}
+        self._refresh_opt_state()
         # NB: in loose mode optimizer slots are worker-local by design —
         # the reference shares slots on the PS, but concurrent slot updates
         # under relaxed consistency are racy there too; device-local slots
