@@ -330,8 +330,11 @@ class AutoDist:
         def wrapper(*args, **kwargs):
             key = id(fn)
             if key not in self._fn_cache:
-                self._fn_cache[key] = self._build_fn(fn, *args, **kwargs)
-            return self._fn_cache[key](*args, **kwargs)
+                # the entry holds a strong ref to fn: id() stays unique
+                # for as long as the cache key exists (no id-reuse alias)
+                self._fn_cache[key] = (fn,
+                                       self._build_fn(fn, *args, **kwargs))
+            return self._fn_cache[key][1](*args, **kwargs)
         return wrapper
 
     def _build_fn(self, fn, *args, **kwargs):
@@ -367,16 +370,26 @@ class AutoDist:
                 kwargs_ph[k] = ph
             else:
                 kwargs_ph[k] = v
-        with graph:
-            fetches = fn(*args_ph, **kwargs_ph)
+        def _rollback():
+            del graph.nodes[nodes_before:]
+            for name in set(graph.variables) - vars_before:
+                del graph.variables[name]
+            graph.grad_target_pairs = pairs_before
+            del graph.optimizers[opts_before:]
+
+        try:
+            with graph:
+                fetches = fn(*args_ph, **kwargs_ph)
+        except Exception:
+            # a partially-traced later function must not poison the
+            # shared graph (orphan nodes trip the mutation guard)
+            if extending:
+                _rollback()
+            raise
         if extending:
             new_vars = set(graph.variables) - vars_before
             if new_vars:
-                del graph.nodes[nodes_before:]
-                for name in new_vars:
-                    del graph.variables[name]
-                graph.grad_target_pairs = pairs_before
-                del graph.optimizers[opts_before:]
+                _rollback()
                 raise ValueError(
                     "a later 'autodist.function' created new variables %s "
                     "after the strategy was built; create all variables "

@@ -59,6 +59,11 @@ class ParallelSpec:
     pp: int = 1
     sp: int = 1
     ep: int = 1
+    # multi-slice factor: the data axis is split this many ways across
+    # slice (DCN) boundaries, so DP gradient reduction is the only
+    # cross-DCN traffic while tp/pp/sp/ep stay on ICI within a slice.
+    # Must divide the resolved dp.
+    dcn_dp: int = 1
     zero: int = 1
     remat: str = 'none'
     microbatches: int = 1          # pipeline microbatches (pp>1)
@@ -102,7 +107,8 @@ class ParallelSpec:
         if total > len(devices):
             raise ValueError('ParallelSpec wants %d devices, have %d'
                              % (total, len(devices)))
-        arr = np.array(devices[:total]).reshape(sizes)
+        from autodist_tpu.parallel.mesh import device_mesh_array
+        arr = device_mesh_array(sizes, devices, dcn_dp=self.dcn_dp)
         return Mesh(arr, names)
 
 

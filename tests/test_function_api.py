@@ -106,3 +106,27 @@ def test_later_function_with_new_variable_rejected():
         # the rejected trace must roll back: no orphan nodes tripping
         # the mutation guard, and f keeps working unchanged
         assert float(f(x)) == before
+
+
+def test_failing_later_trace_rolls_back():
+    """A later function whose body RAISES mid-trace must not leave
+    orphan nodes poisoning the shared graph."""
+    autodist = _fresh()
+    with autodist.scope():
+        v = ad.Variable(1.0, name='v')
+
+        @autodist.function
+        def f(x):
+            return ad.ops.reduce_mean(x * v.read())
+
+        x = np.ones(8, np.float32)
+        before = float(f(x))
+
+        @autodist.function
+        def bad(x):
+            t = x * 2.0 + v.read()   # traces some nodes first
+            raise RuntimeError('boom')
+
+        with pytest.raises(RuntimeError, match='boom'):
+            bad(x)
+        assert float(f(x)) == before
