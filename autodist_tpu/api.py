@@ -361,12 +361,19 @@ class Trainer:
 
     # -- fit/evaluate conveniences (reference case c7's Model.fit role) ----
     def fit(self, state, data, steps=None, eval_data=None, eval_every=0,
-            checkpoint_manager=None, save_every=0):
+            checkpoint_manager=None, save_every=0, prefetch=0):
         """Train over an iterable of batches (c7 ``Model.fit`` role).
 
         Args:
             state: TrainState from :meth:`init`.
             data: iterable (or iterator) of batch dicts.
+            prefetch: keep this many device-placed batches in flight so
+                host->device transfer overlaps compute (0 = off). Safe
+                with :meth:`step`: already-placed arrays pass through
+                its ``shard_batch`` untouched. NB with ``steps=N`` the
+                prefetcher reads up to ``prefetch`` batches PAST the
+                N-th from ``data`` — don't share one live iterator
+                across fit() phases with prefetch on.
             steps: stop after this many steps (None = exhaust ``data``).
             eval_data: optional sequence of eval batches.
             eval_every: run :meth:`evaluate` every N steps (0 = only at
@@ -385,6 +392,10 @@ class Trainer:
         history = {'loss': []}
         if eval_data is not None:
             history['eval_loss'] = []
+        if prefetch:
+            from autodist_tpu.data.prefetch import prefetch_to_device
+            data = prefetch_to_device(data, self.shard_batch,
+                                      size=prefetch)
         it = iter(data)
         n = 0
         for batch in it:

@@ -347,11 +347,10 @@ class AutoDist:
         # guard and orphan variables break var-state iteration.
         graph = self._original_graph_item.graph
         extending = self._session is not None
-        if extending:
-            nodes_before = len(graph.nodes)
-            vars_before = set(graph.variables)
-            pairs_before = dict(graph.grad_target_pairs)
-            opts_before = len(graph.optimizers)
+        nodes_before = len(graph.nodes)
+        vars_before = set(graph.variables)
+        pairs_before = dict(graph.grad_target_pairs)
+        opts_before = len(graph.optimizers)
         ph_index = {}
         args_ph, kwargs_ph = [], {}
         for i, a in enumerate(args):
@@ -381,10 +380,10 @@ class AutoDist:
             with graph:
                 fetches = fn(*args_ph, **kwargs_ph)
         except Exception:
-            # a partially-traced later function must not poison the
-            # shared graph (orphan nodes trip the mutation guard)
-            if extending:
-                _rollback()
+            # a partially-traced function must not poison the shared
+            # graph: orphan nodes trip the mutation guard (extending) or
+            # leave duplicate-variable landmines for a retried first trace
+            _rollback()
             raise
         if extending:
             new_vars = set(graph.variables) - vars_before

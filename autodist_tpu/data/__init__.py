@@ -1,3 +1,4 @@
 """Input pipeline: native prefetching record loader + host sharding."""
 from autodist_tpu.data.loader import (DataLoader, read_record_header,  # noqa: F401
                                       write_records)
+from autodist_tpu.data.prefetch import prefetch_to_device  # noqa: F401

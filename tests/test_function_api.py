@@ -130,3 +130,26 @@ def test_failing_later_trace_rolls_back():
         with pytest.raises(RuntimeError, match='boom'):
             bad(x)
         assert float(f(x)) == before
+
+
+def test_failing_first_trace_rolls_back():
+    """A FIRST function whose body raises mid-trace (before any session
+    exists) must roll back too: retrying after a fix must not hit
+    duplicate-variable registration from the dead trace."""
+    autodist = _fresh()
+    with autodist.scope():
+        state = {'boom': True}
+
+        @autodist.function
+        def f(x):
+            w = ad.Variable(0.5, name='w')
+            if state['boom']:
+                raise RuntimeError('first try fails')
+            return ad.ops.reduce_mean(x * w.read())
+
+        x = np.ones(8, np.float32)
+        with pytest.raises(RuntimeError, match='first try fails'):
+            f(x)
+        state['boom'] = False
+        autodist._fn_cache.clear()   # retry rebuilds the trace
+        assert abs(float(f(x)) - 0.5) < 1e-6
