@@ -77,6 +77,17 @@ class Trainer:
         self.param_shardings = shardings_for_tree(
             self._axes_tree, self.rules, self.mesh)
         self._step_cache = {}
+        # model state (BatchNorm running stats): non-trainable leaves
+        # advance via recorded updates, not the optimizer
+        self._has_state = getattr(model, 'has_state', lambda: False)()
+        if self._has_state:
+            from autodist_tpu.models.core import assign_state_paths
+            assign_state_paths(model)
+            self._trainable_mask = model.trainable_mask()
+            self._state_paths = [
+                tuple(str(k.key) for k in path)
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    self._trainable_mask)[0] if not leaf]
         logging.info('Trainer mesh: %s, zero=%d, sp=%d',
                      dict(self.mesh.shape), self.spec.zero, self.spec.sp)
 
@@ -269,12 +280,36 @@ class Trainer:
         accum = max(1, int(getattr(self.spec, 'grad_accum', 1)))
 
         def grads_of(params, batch):
+            """(loss, grads, state_updates) — updates is {} for
+            stateless models."""
+            from autodist_tpu.models.core import model_mode
+
             def loss_fn(p):
                 with sharding_ctx(self.mesh, self.rules):
-                    return self.loss_for(p, batch)
+                    if not self._has_state:
+                        return self.loss_for(p, batch), {}
+                    with model_mode(training=True) as mm:
+                        loss = self.loss_for(p, batch)
+                    return loss, dict(mm.updates)
             if self.spec.remat == 'full':
                 loss_fn = jax.checkpoint(loss_fn)
-            return jax.value_and_grad(loss_fn)(params)
+            (loss, updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, grads, updates
+
+        def apply_updates(params, opt_updates, state_updates):
+            from autodist_tpu.models.core import apply_tree_updates
+            if not self._has_state:
+                return jax.tree.map(
+                    lambda p, u: p + u.astype(p.dtype),
+                    params, opt_updates)
+            # static bool mask: state leaves skip the optimizer entirely
+            # (weight decay etc. must not touch running statistics) and
+            # take their recorded updates instead
+            new_params = jax.tree.map(
+                lambda p, u, m: (p + u.astype(p.dtype)) if m else p,
+                params, opt_updates, self._trainable_mask)
+            return apply_tree_updates(new_params, state_updates)
 
         def step_fn(state, batch):
             if accum > 1:
@@ -293,29 +328,48 @@ class Trainer:
                 chunked = jax.tree.map(_chunk, batch)
 
                 def body(acc, chunk):
-                    loss_c, grads_c = grads_of(state.params, chunk)
-                    acc_loss, acc_grads = acc
+                    loss_c, grads_c, upd_c = grads_of(state.params, chunk)
+                    acc_loss, acc_grads, _ = acc
+                    # state (BN EMA) keeps the LAST chunk's update: each
+                    # chunk computes its EMA from the pre-step state, so
+                    # the running stats advance once per optimizer step
                     return (acc_loss + loss_c,
-                            jax.tree.map(jnp.add, acc_grads, grads_c)), \
-                        None
+                            jax.tree.map(jnp.add, acc_grads, grads_c),
+                            upd_c), None
 
                 zero = (jnp.zeros((), jnp.float32),
                         jax.tree.map(
                             lambda p: jnp.zeros(p.shape, jnp.float32),
-                            state.params))
-                (loss, grads), _ = jax.lax.scan(body, zero, chunked)
+                            state.params),
+                        self._initial_state_updates(state.params))
+                (loss, grads, state_updates), _ = jax.lax.scan(
+                    body, zero, chunked)
                 loss = loss / accum
                 grads = jax.tree.map(lambda g: g / accum, grads)
             else:
-                loss, grads = grads_of(state.params, batch)
+                loss, grads, state_updates = grads_of(state.params, batch)
             updates, new_opt = self.optimizer.update(
                 grads, state.opt_state, state.params)
-            new_params = jax.tree.map(
-                lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+            new_params = apply_updates(state.params, updates,
+                                       state_updates)
             return TrainState(params=new_params, opt_state=new_opt,
                               step=state.step + 1), {'loss': loss}
 
         return step_fn
+
+    def _initial_state_updates(self, params):
+        """Scan carry skeleton for state updates: current values of the
+        non-trainable leaves (so chunk 1's replacement has a matching
+        structure)."""
+        if not self._has_state:
+            return {}
+        out = {}
+        for path in self._state_paths:
+            node = params
+            for key in path:
+                node = node[key]
+            out[path] = node
+        return out
 
     def _step_key(self, batch):
         struct = jax.tree.structure(batch)
@@ -445,8 +499,11 @@ class Trainer:
                 def eval_fn(params, batch):
                     # same sharding context as step: constrain() hints
                     # and sharding-aware module paths (e.g. the sharded
-                    # embedding lookup) stay active during eval
-                    with sharding_ctx(self.mesh, self.rules):
+                    # embedding lookup) stay active during eval; eval
+                    # mode makes BatchNorm use its running statistics
+                    from autodist_tpu.models.core import model_mode
+                    with sharding_ctx(self.mesh, self.rules), \
+                            model_mode(training=False):
                         out = {'loss': self.loss_for(params, batch)}
                         if metrics_fn is not None:
                             out.update(metrics_fn(params, batch))

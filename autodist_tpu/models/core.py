@@ -66,6 +66,10 @@ class ParamDef:
     axes: tuple            # logical axis names, len == len(shape)
     init: str = 'normal'   # normal | zeros | ones | fan_in
     scale: float = 0.02
+    # False = a STATE leaf (e.g. BatchNorm running stats): lives in the
+    # params tree for checkpoint/sharding purposes, but the optimizer
+    # must not touch it — it advances via record_state_update instead.
+    trainable: bool = True
 
 
 class Module:
@@ -90,8 +94,124 @@ class Module:
         return {name: (d.axes() if isinstance(d, Module) else d.axes)
                 for name, d in sorted(self.param_defs().items())}
 
+    def trainable_mask(self):
+        """Bool tree mirroring ``init``: False at state leaves."""
+        return {name: (d.trainable_mask() if isinstance(d, Module)
+                       else d.trainable)
+                for name, d in sorted(self.param_defs().items())}
+
+    def has_state(self):
+        return not all(jax.tree.leaves(self.trainable_mask()))
+
     def __call__(self, params, *args, **kwargs):
         return self.apply(params, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Model state (BatchNorm running stats etc.)
+#
+# State leaves live in the params tree (so sharding/checkpointing need no
+# second tree) but advance through a trace-time side channel: during the
+# loss trace a collector is active, stateful modules call
+# ``record_state_update(path, value)``, and the trainer folds the updates
+# back into the non-trainable leaves INSTEAD of an optimizer step. Paths
+# are assigned to module instances once per trainer (``assign_state_paths``),
+# which requires stateful modules to be held as attributes (they are).
+# ---------------------------------------------------------------------------
+import threading as _threading
+
+_MODEL_CTX = _threading.local()
+
+
+class _StateCollector:
+    def __init__(self, training):
+        self.training = training
+        self.updates = {}    # path tuple -> new value (tracer ok)
+
+
+class model_mode:
+    """Context: set training/eval mode and collect state updates during
+    a (traced) forward. ``updates`` is populated at trace time."""
+
+    def __init__(self, training=True):
+        self._col = _StateCollector(training)
+
+    @property
+    def updates(self):
+        return self._col.updates
+
+    def __enter__(self):
+        stack = getattr(_MODEL_CTX, 'stack', None)
+        if stack is None:
+            stack = _MODEL_CTX.stack = []
+        stack.append(self._col)
+        return self
+
+    def __exit__(self, *exc):
+        _MODEL_CTX.stack.pop()
+
+
+def _collector():
+    stack = getattr(_MODEL_CTX, 'stack', None)
+    return stack[-1] if stack else None
+
+
+def is_training():
+    """True outside any model_mode context (benchmark semantics)."""
+    col = _collector()
+    return True if col is None else col.training
+
+
+def record_state_update(module, name, value):
+    """Record a new value for state leaf ``name`` of ``module`` (no-op
+    when no collector is active, e.g. plain benchmark forwards)."""
+    col = _collector()
+    if col is None:
+        return
+    path = getattr(module, '_state_path', None)
+    if path is None:
+        raise ValueError(
+            '%s has state but no assigned path — build it through a '
+            'Trainer (assign_state_paths) to track running statistics'
+            % type(module).__name__)
+    col.updates[path + (name,)] = value
+
+
+def assign_state_paths(module, prefix=(), _seen=None):
+    """Walk the module tree ONCE, stamping each submodule with its param
+    path so state updates can be folded back by position.
+
+    Stateful modules must occupy exactly ONE tree position and run once
+    per loss forward — a single stamped path cannot represent two
+    positions, so sharing a stateful instance (e.g. one BatchNorm used
+    twice) is rejected here rather than silently dropping updates.
+    Stateless instances may be shared freely."""
+    if _seen is None:
+        _seen = set()
+    if id(module) in _seen and module.has_state():
+        raise ValueError(
+            'stateful module %s appears at multiple tree positions '
+            '(%s and %s); give each position its own instance so its '
+            'running statistics have a unique home'
+            % (type(module).__name__, module._state_path, prefix))
+    _seen.add(id(module))
+    module._state_path = prefix
+    for name, d in module.param_defs().items():
+        if isinstance(d, Module):
+            assign_state_paths(d, prefix + (name,), _seen)
+
+
+def apply_tree_updates(tree, updates):
+    """Return a copy of ``tree`` with ``{path tuple: value}`` entries
+    replaced (copy-on-write along each path; the input is untouched)."""
+    out = dict(tree)
+    for path, value in updates.items():
+        node = out
+        for key in path[:-1]:
+            node[key] = dict(node[key])
+            node = node[key]
+        node[path[-1]] = value.astype(node[path[-1]].dtype)
+    return out
 
 
 def _init_leaf(rng, d):

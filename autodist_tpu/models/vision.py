@@ -7,12 +7,13 @@ convolutions), bfloat16 compute with float32 master weights and float32
 batch-norm statistics, channels padded by construction to MXU-friendly
 multiples in the standard configs.
 
-BatchNorm note: training mode normalizes with batch statistics (what the
-throughput benchmarks exercise); running-stat EMA for eval is carried as
-non-trainable state via ``Trainer`` collections being out of scope this
-layer — ``is_training=False`` reuses batch stats. This matches the
-benchmark semantics of the reference's examples, not full tf.layers
-eval-mode parity.
+BatchNorm note: training mode normalizes with batch statistics; running
+mean/variance EMAs are carried as non-trainable state leaves in the
+params tree and advance through the Trainer's state-update channel
+(tf.layers ``moving_mean``/``moving_variance`` parity). Eval mode
+(``Trainer.evaluate`` / ``model_mode(training=False)``) normalizes with
+the running statistics. Plain forwards outside any mode context keep
+batch-stat semantics (what the throughput benchmarks exercise).
 """
 import jax
 import jax.numpy as jnp
@@ -52,17 +53,43 @@ class Conv(Module):
 
 
 class BatchNorm(Module):
-    def __init__(self, ch, eps=1e-5, dtype=jnp.float32):
+    """Batch normalization with running statistics.
+
+    Training mode (the default outside any ``model_mode`` context —
+    benchmark semantics) normalizes with batch statistics and, when a
+    state collector is active, records EMA updates of mean/var into the
+    non-trainable ``ema_mean``/``ema_var`` leaves (tf.layers
+    ``moving_mean``/``moving_variance`` parity). Eval mode
+    (``model_mode(training=False)``, used by ``Trainer.evaluate``)
+    normalizes with the running statistics."""
+
+    def __init__(self, ch, eps=1e-5, momentum=0.9, dtype=jnp.float32):
         self.ch, self.eps, self.dtype = ch, eps, dtype
+        self.momentum = momentum
 
     def param_defs(self):
         return {'scale': ParamDef((self.ch,), (None,), 'ones'),
-                'bias': ParamDef((self.ch,), (None,), 'zeros')}
+                'bias': ParamDef((self.ch,), (None,), 'zeros'),
+                'ema_mean': ParamDef((self.ch,), (None,), 'zeros',
+                                     trainable=False),
+                'ema_var': ParamDef((self.ch,), (None,), 'ones',
+                                    trainable=False)}
 
     def apply(self, params, x):
+        from autodist_tpu.models.core import (is_training,
+                                              record_state_update)
         x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=(0, 1, 2))
-        var = jnp.var(x32, axis=(0, 1, 2))
+        if is_training():
+            mean = jnp.mean(x32, axis=(0, 1, 2))
+            var = jnp.var(x32, axis=(0, 1, 2))
+            m = self.momentum
+            record_state_update(
+                self, 'ema_mean', m * params['ema_mean'] + (1 - m) * mean)
+            record_state_update(
+                self, 'ema_var', m * params['ema_var'] + (1 - m) * var)
+        else:
+            mean = params['ema_mean']
+            var = params['ema_var']
         y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
         y = y * params['scale'] + params['bias']
         return y.astype(self.dtype)

@@ -144,3 +144,116 @@ def test_chunked_ce_indivisible_rows_falls_back():
     l0 = float(jax.jit(plain.loss)(params, batch))
     l1 = float(jax.jit(chunked.loss)(params, batch))
     assert abs(l0 - l1) < 1e-6
+
+
+def test_batchnorm_running_stats_advance_and_serve_eval():
+    """BN EMAs advance during Trainer.step (state channel, not the
+    optimizer) and Trainer.evaluate normalizes with them."""
+    from autodist_tpu.models import vision
+
+    model = vision.ResNet((1, 1), num_classes=10)
+    tr = Trainer(model, optax.adamw(0.01), spec=ParallelSpec(dp=1))
+    assert tr._has_state
+    batch = _image_batch(n=8, hw=32)
+    state = tr.init(jax.random.PRNGKey(0))
+
+    def stem_ema(s):
+        return np.asarray(s.params['stem']['bn']['ema_mean'])
+
+    ema0 = stem_ema(state)
+    assert np.allclose(ema0, 0.0)          # fresh stats
+    state, _ = tr.step(state, batch)
+    ema1 = stem_ema(state)
+    assert not np.allclose(ema1, 0.0)      # advanced by the step
+    state, _ = tr.step(state, batch)
+    ema2 = stem_ema(state)
+    assert not np.allclose(ema2, ema1)
+
+    # eval uses the running stats: loss differs from a fresh-stats model
+    # evaluated on the same params ONLY through the ema leaves
+    eval_loss = tr.evaluate(state, [batch])
+    frozen = jax.tree.map(lambda x: x, state.params)
+    frozen['stem']['bn']['ema_mean'] = jnp.ones_like(
+        frozen['stem']['bn']['ema_mean']) * 5.0
+    state2 = state.__class__(params=frozen, opt_state=state.opt_state,
+                             step=state.step)
+    eval_loss2 = tr.evaluate(state2, [batch])
+    assert np.isfinite(eval_loss) and np.isfinite(eval_loss2)
+    assert abs(eval_loss - eval_loss2) > 1e-6
+
+
+def test_batchnorm_ema_not_touched_by_weight_decay():
+    """adamw's weight decay must not decay the EMA leaves: after one
+    step the EMA equals EXACTLY m*ema0 + (1-m)*batch_stat — any
+    optimizer contribution (decay shifts ~3% here) would break it."""
+    from autodist_tpu.models.core import Module
+    from autodist_tpu.models.vision import BatchNorm
+
+    class BnModel(Module):
+        def __init__(self):
+            self.bn = BatchNorm(3)
+
+        def param_defs(self):
+            return {'bn': self.bn}
+
+        def loss(self, params, batch):
+            return (self.bn.apply(params['bn'], batch['x']) ** 2).mean()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4, 4, 3).astype('f4')
+    tr = Trainer(BnModel(), optax.adamw(0.05, weight_decay=0.5),
+                 spec=ParallelSpec(dp=1))
+    state = tr.init(jax.random.PRNGKey(0))
+    state, _ = tr.step(state, {'x': x})
+    m = 0.9
+    want_mean = m * 0.0 + (1 - m) * x.mean((0, 1, 2))
+    want_var = m * 1.0 + (1 - m) * x.var((0, 1, 2))
+    np.testing.assert_allclose(
+        np.asarray(state.params['bn']['ema_mean']), want_mean, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(state.params['bn']['ema_var']), want_var, atol=1e-6)
+
+
+def test_shared_stateful_module_rejected():
+    """One BatchNorm instance at two tree positions cannot carry two
+    running-stat homes — Trainer construction must refuse it."""
+    from autodist_tpu.models.core import Module
+    from autodist_tpu.models.vision import BatchNorm
+
+    class Shared(Module):
+        def __init__(self):
+            self.bn = BatchNorm(3)
+
+        def param_defs(self):
+            return {'a': self.bn, 'b': self.bn}
+
+        def loss(self, params, batch):   # pragma: no cover
+            return 0.0
+
+    with pytest.raises(ValueError, match='multiple tree positions'):
+        Trainer(Shared(), optax.sgd(0.1), spec=ParallelSpec(dp=1))
+
+
+def test_apply_tree_updates_is_copy_on_write():
+    from autodist_tpu.models.core import apply_tree_updates
+    tree = {'a': {'b': jnp.zeros(2), 'c': jnp.ones(2)}}
+    out = apply_tree_updates(tree, {('a', 'b'): jnp.full((2,), 7.0)})
+    assert np.allclose(out['a']['b'], 7.0)
+    assert np.allclose(tree['a']['b'], 0.0)   # input untouched
+    assert out['a']['c'] is tree['a']['c']    # untouched leaves shared
+
+
+def test_grad_accum_with_batchnorm_state():
+    """grad_accum composes with the state channel (last-chunk EMA)."""
+    from autodist_tpu.models import vision
+
+    model = vision.ResNet((1, 1), num_classes=10)
+    tr = Trainer(model, optax.sgd(0.01),
+                 spec=ParallelSpec(dp=1, grad_accum=2))
+    batch = _image_batch(n=8, hw=32)
+    state = tr.init(jax.random.PRNGKey(0))
+    ema0 = np.asarray(state.params['stem']['bn']['ema_mean'])
+    state, m = tr.step(state, batch)
+    ema1 = np.asarray(state.params['stem']['bn']['ema_mean'])
+    assert np.isfinite(float(m['loss']))
+    assert not np.allclose(ema1, ema0)
