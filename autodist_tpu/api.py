@@ -472,6 +472,9 @@ class Trainer:
         if checkpoint_manager is not None and (not save_every or
                                                n % save_every):
             self.save_state(checkpoint_manager, state)
+        if checkpoint_manager is not None and \
+                hasattr(checkpoint_manager, 'wait_until_finished'):
+            checkpoint_manager.wait_until_finished()   # drain async save
         return state, history
 
     def evaluate(self, state, batches, metrics_fn=None):

@@ -132,14 +132,27 @@ class CheckpointManager:
     manifest + .npy layout; ``backend='orbax'`` delegates tensor IO to
     orbax/tensorstore (sharded files, async flush) while keeping the
     same directory/retention/latest-step contract.
+
+    ``async_save=True`` makes ``save`` non-blocking: the values are
+    snapshotted to host (npy) or handed to orbax's async checkpointer
+    (which copies device->host before returning, so donated buffers are
+    safe) and the file write overlaps subsequent training steps. At
+    most one save is in flight; a new ``save``, ``restore``, or
+    ``wait_until_finished`` drains the previous one first.
     """
 
-    def __init__(self, directory, max_to_keep=3, backend='npy'):
+    def __init__(self, directory, max_to_keep=3, backend='npy',
+                 async_save=False):
         if backend not in ('npy', 'orbax'):
             raise ValueError('backend must be npy or orbax: %r' % backend)
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.backend = backend
+        self.async_save = async_save
+        self._async_ckptr = None   # orbax AsyncCheckpointer (lazy)
+        self._pending = None       # npy writer thread
+        self._pending_error = None
+        self._pending_sidecar = None
         os.makedirs(directory, exist_ok=True)
 
     def _ckpt_path(self, step):
@@ -160,17 +173,94 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step, tree):
+        if self.async_save:
+            return self._save_async(step, tree)
         save_fn = save_pytree_orbax if self.backend == 'orbax' \
             else save_pytree
         path = save_fn(self._ckpt_path(step), tree, step=step)
+        self._retain()
+        return path
+
+    def _retain(self):
         for old in self.all_steps()[:-self.max_to_keep]:
             shutil.rmtree(self._ckpt_path(old))
             sidecar = self._ckpt_path(old) + '.step'
             if os.path.exists(sidecar):
                 os.remove(sidecar)
+
+    def _save_async(self, step, tree):
+        self.wait_until_finished()   # one save in flight at a time
+        path = self._ckpt_path(step)
+        if self.backend == 'orbax':
+            import orbax.checkpoint as ocp
+            if self._async_ckptr is None:
+                self._async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler())
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            # blocks only for the device->host copy; the file flush
+            # continues in the background while training proceeds
+            self._async_ckptr.save(
+                os.path.abspath(path),
+                args=ocp.args.StandardSave(
+                    jax.tree.map(jnp_or_np_asarray, tree)))
+            # sidecar is written AFTER the flush is durable (in
+            # wait_until_finished) — a crash mid-flush must not leave a
+            # sidecar claiming a checkpoint that never finalized
+            self._pending_sidecar = (path, step)
+        else:
+            # snapshot to host NOW (subsequent steps may donate the
+            # device buffers), write in a daemon thread
+            host = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), tree)
+
+            def write():
+                try:
+                    save_pytree(path, host, step=step)
+                except Exception as e:   # noqa: BLE001 - surfaced on join
+                    self._pending_error = e
+            import threading
+            # non-daemon: an un-drained save still completes at
+            # interpreter exit instead of dying mid-write
+            self._pending = threading.Thread(target=write, daemon=False)
+            self._pending.start()
+        # retention sees only FINISHED checkpoints (the in-flight dir
+        # may not exist yet), so transiently max_to_keep+1 can exist
+        self._retain()
         return path
 
+    def wait_until_finished(self):
+        """Drain any in-flight async save (raises its error, if any),
+        then re-apply retention — the drained save was invisible to the
+        retention pass that ran when it started."""
+        if self._async_ckptr is not None:
+            self._async_ckptr.wait_until_finished()
+            sidecar = getattr(self, '_pending_sidecar', None)
+            if sidecar is not None:
+                path, step = sidecar
+                self._pending_sidecar = None
+                if os.path.exists(path):   # flush finalized the dir
+                    with open(path + '.step', 'w') as f:
+                        json.dump({'step': step}, f)
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+            if self._pending_error is not None:
+                err, self._pending_error = self._pending_error, None
+                raise err
+        if self.async_save:
+            self._retain()
+
+    def close(self):
+        """Drain in-flight saves and release the async checkpointer's
+        worker resources. Safe to call multiple times."""
+        self.wait_until_finished()
+        if self._async_ckptr is not None:
+            self._async_ckptr.close()
+            self._async_ckptr = None
+
     def restore(self, like=None, step=None):
+        self.wait_until_finished()
         step = step if step is not None else self.latest_step()
         if step is None:
             return None, None
