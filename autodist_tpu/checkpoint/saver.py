@@ -218,6 +218,10 @@ class CheckpointManager:
                 try:
                     save_pytree(path, host, step=step)
                 except Exception as e:   # noqa: BLE001 - surfaced on join
+                    # also log NOW: if the process exits without a
+                    # drain, the stored error would vanish silently
+                    logging.error('async checkpoint write to %s '
+                                  'failed: %s', path, e)
                     self._pending_error = e
             import threading
             # non-daemon: an un-drained save still completes at

@@ -235,19 +235,23 @@ def test_async_save_roundtrip_and_retention(tmp_path, backend):
     mgr = CheckpointManager(str(tmp_path / 'ck'), max_to_keep=2,
                             backend=backend, async_save=True)
     trees = {}
-    for step in (1, 2, 3):
-        tree = {'w': jnp.full((4,), float(step)),
-                'b': {'x': jnp.arange(3, dtype=jnp.float32) * step}}
-        trees[step] = jax.tree.map(np.asarray, tree)
-        mgr.save(step, tree)
-    mgr.wait_until_finished()
-    assert mgr.all_steps() == [2, 3]        # retention kept latest 2
-    got, got_step = mgr.restore(
-        like=jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), trees[3]))
-    assert got_step == 3
-    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(trees[3])):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    try:
+        for step in (1, 2, 3):
+            tree = {'w': jnp.full((4,), float(step)),
+                    'b': {'x': jnp.arange(3, dtype=jnp.float32) * step}}
+            trees[step] = jax.tree.map(np.asarray, tree)
+            mgr.save(step, tree)
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [2, 3]    # retention kept latest 2
+        got, got_step = mgr.restore(
+            like=jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                trees[3]))
+        assert got_step == 3
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(trees[3])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        mgr.close()   # release the orbax async worker
 
 
 def test_async_save_error_surfaces_on_wait(tmp_path):
