@@ -66,6 +66,10 @@ class ENV(Enum):
     AUTODIST_COORD_SERVICE_ADDR = (lambda v: v if v else '',)        # host:port for native coord service
     AUTODIST_RUN_ID = (lambda v: v if v else '',)                    # launcher-issued run nonce (namespaces coord keys)
     AUTODIST_DUMP_GRAPHS = (lambda v: (v == 'True' or v == '1'),)    # dump jaxpr/HLO per phase
+    # loose-mode failure detection: a peer whose heartbeat is older than
+    # this many seconds is declared dead while we wait on the staleness
+    # gate (0 disables). Keep it longer than the slowest expected step.
+    AUTODIST_HEARTBEAT_TIMEOUT = (lambda v: float(v) if v else 60.0,)
 
     @property
     def val(self):

@@ -196,21 +196,60 @@ class CoordClient:
             self.incr(key, step - cur)
 
     def staleness_gate(self, step, staleness, num_workers,
-                       timeout_s=600.0, prefix='step/'):
-        """Block until every worker is within ``staleness`` steps."""
+                       timeout_s=600.0, prefix='step/',
+                       failure_check=None, slice_s=2.0):
+        """Block until every worker is within ``staleness`` steps.
+
+        With ``failure_check`` (a callable that raises when a peer is
+        known dead), the server-side wait is chunked into ``slice_s``
+        slices and the check runs between slices — a crashed peer
+        surfaces as its error instead of a full-window TimeoutError.
+        """
         if step <= staleness:
             return
-        self.min_wait(prefix, step - staleness, num_workers, timeout_s)
+        if failure_check is None:
+            self.min_wait(prefix, step - staleness, num_workers,
+                          timeout_s)
+            return
+        deadline = time.time() + timeout_s
+        while True:
+            failure_check()
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError('staleness_gate(%s, %d)'
+                                   % (prefix, step))
+            try:
+                self.min_wait(prefix, step - staleness, num_workers,
+                              min(slice_s, remaining))
+                return
+            except TimeoutError:
+                continue
 
     # -- composite: heartbeat / failure detection --------------------------
+    # Liveness is a monotonic BEAT COUNTER, not a timestamp: each consumer
+    # judges "no advance for > timeout" against its OWN clock, so
+    # wall-clock skew between hosts can neither kill healthy peers nor
+    # mask dead ones.
     def heartbeat(self, worker):
-        self.set('hb/%s' % worker, str(time.time()))
+        self.incr('hb/%s' % worker, 1)
 
-    def dead_workers(self, workers, timeout_s):
-        now = time.time()
+    def beat_count(self, worker):
+        """Current beat counter for ``worker`` (0 = never beat)."""
+        return self.incr('hb/%s' % worker, 0)
+
+    def dead_workers(self, workers, timeout_s, observations,
+                     now=None):
+        """Workers whose beat counter has not advanced for ``timeout_s``
+        on THIS process's clock. ``observations`` is caller-owned state
+        {worker: (last_count, local_time_first_seen)} updated in place."""
+        now = time.time() if now is None else now
         dead = []
         for w in workers:
-            raw = self.get('hb/%s' % w)
-            if raw is None or now - float(raw) > timeout_s:
+            cnt = self.beat_count(w)
+            last = observations.get(w)
+            if last is None or cnt != last[0]:
+                observations[w] = (cnt, now)
+                continue
+            if now - last[1] > timeout_s:
                 dead.append(w)
         return dead

@@ -160,6 +160,30 @@ class Session:
         """Another worker's published completed-step counter (0 if none)."""
         return self._coord.incr(self._key('step/') + 'p%d' % process_id, 0)
 
+    def _check_peers_alive(self):
+        """Fail fast while blocked on the staleness gate if a peer has
+        stopped heartbeating (reference coordinator.py:98-110 monitors
+        hard-exit the chief when a worker dies; here the signal is a
+        stalled coord-service beat counter, judged on this process's
+        own clock — immune to cross-host clock skew)."""
+        timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
+        if not timeout:
+            return
+        # a waiter is alive: refresh our own beat every gate slice so
+        # peers also blocked on the gate never declare US dead just
+        # because the wait outlasted the timeout
+        self._coord.heartbeat(self._key(self._worker_name))
+        names = [self._key('p%d' % i) for i in range(self._num_workers)
+                 if i != ENV.AUTODIST_PROCESS_ID.val]
+        if not hasattr(self, '_hb_seen'):
+            self._hb_seen = {}
+        dead = self._coord.dead_workers(names, timeout, self._hb_seen)
+        if dead:
+            raise RuntimeError(
+                'worker(s) %s missed heartbeats for > %.0fs while this '
+                'process waited on the staleness gate — failing fast '
+                'instead of hanging' % (sorted(dead), timeout))
+
     # -- multi-process placement helpers ----------------------------------
     def _put(self, value, sharding):
         """Place a host value that is logically global (same on every
@@ -216,6 +240,9 @@ class Session:
                 for name, var in self._graph_item.graph.variables.items():
                     self._coord.vset(self._key('var/%s' % name),
                                      np.asarray(var.init_value))
+            # heartbeat baseline BEFORE the barrier: once any gate runs,
+            # every peer has a timestamp (a missing one reads as dead)
+            self._coord.heartbeat(self._key(self._worker_name))
             self._coord.barrier(self._key('session/init'),
                                 self._num_workers, timeout_s=120.0)
             if not self._is_chief:
@@ -326,10 +353,12 @@ class Session:
             # every worker must have completed >= s - staleness steps.
             # sync=False vars are unconditional no-wait (ps_strategy.py:
             # 30-35); any sync var imposes its (tightest) bound.
+            self._coord.heartbeat(self._key(self._worker_name))
             if is_train and self._plan.gate_enabled:
                 self._coord.staleness_gate(
                     self._step_count + 1, self._plan.gate_staleness,
-                    self._num_workers, prefix=self._key('step/'))
+                    self._num_workers, prefix=self._key('step/'),
+                    failure_check=self._check_peers_alive)
             pulled = self._pull_ps_vars()
 
         placed = []

@@ -75,8 +75,13 @@ COMMON_PRELUDE = textwrap.dedent("""
 """)
 
 
-def launch_pair(tmp_path, script_body, timeout=300):
-    """Write the script, run it as 2 launch_cli-style local processes."""
+def launch_pair(tmp_path, script_body, timeout=300, extra_env=None,
+                require_result=(True, True)):
+    """Write the script, run it as 2 launch_cli-style local processes.
+
+    ``require_result[i]``: process i must exit 0 and print a RESULT
+    line; False = any exit code, RESULT optional (crash-test workers).
+    """
     script = tmp_path / 'prog.py'
     script.write_text(COMMON_PRELUDE % {'repo': REPO} + script_body)
     coord_service = '127.0.0.1:%d' % free_port()
@@ -91,6 +96,7 @@ def launch_pair(tmp_path, script_body, timeout=300):
             'AUTODIST_COORDINATOR_ADDR': jax_coord,
             'AUTODIST_COORD_SERVICE_ADDR': coord_service,
         })
+        env.update(extra_env or {})
         if pid > 0:
             env['AUTODIST_WORKER'] = '127.0.0.1'
         procs.append(subprocess.Popen(
@@ -108,14 +114,17 @@ def launch_pair(tmp_path, script_body, timeout=300):
             outs.append((p.returncode, out, err))
     finally:
         _shutdown_service(coord_service)
-    for rc, out, err in outs:
-        assert rc == 0, 'process failed (rc=%s)\nstdout:\n%s\nstderr:\n%s' \
-            % (rc, out, err[-4000:])
     results = []
-    for _, out, _ in outs:
+    for required, (rc, out, err) in zip(require_result, outs):
+        if required:
+            assert rc == 0, \
+                'process failed (rc=%s)\nstdout:\n%s\nstderr:\n%s' \
+                % (rc, out, err[-4000:])
         line = [ln for ln in out.splitlines() if ln.startswith('RESULT ')]
-        assert line, 'no RESULT line in output:\n%s' % out
-        results.append(json.loads(line[-1][len('RESULT '):]))
+        if required:
+            assert line, 'no RESULT line in output:\n%s' % out
+        results.append(json.loads(line[-1][len('RESULT '):])
+                       if line else None)
     return results
 
 
@@ -231,3 +240,51 @@ def test_async_ps_never_blocks(tmp_path):
     assert max(chief['lead']) >= 5, chief['lead']
     for r in results:
         assert abs(r['b']) > 1e-4
+
+
+@pytest.mark.integration
+def test_dead_worker_fails_fast_not_hangs(tmp_path):
+    """Failure detection: the worker crashes mid-run; the chief, blocked
+    on the staleness gate, must surface a dead-peer error within the
+    heartbeat window instead of hanging for the full gate timeout
+    (reference coordinator.py:98-110 monitors, reinterpreted over
+    coord-service heartbeats)."""
+    body = textwrap.dedent("""
+        STALENESS = 2
+        autodist = ad.AutoDist(
+            resource_info=RESOURCE_INFO,
+            strategy_builder=ad.strategy.PS(staleness=STALENESS))
+        inputs, outputs = make_data(123 if ROLE == 'chief' else 456)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None], dtype=np.float32, name='x')
+            y = ad.placeholder(shape=[None], dtype=np.float32, name='y')
+            W = ad.Variable(5.0, name='W')
+            b = ad.Variable(0.0, name='b')
+            loss = ad.ops.reduce_mean(ad.ops.square(W * x + b - y))
+            train_op = ad.optimizers.SGD(0.01).minimize(loss, [W, b])
+            sess = autodist.create_distributed_session()
+            if ROLE == 'worker':
+                for _ in range(2):
+                    sess.run(train_op, {x: inputs, y: outputs})
+                os._exit(17)   # simulated crash: no cleanup, no barrier
+            t0 = time.time()
+            steps, failed = 0, ''
+            try:
+                for _ in range(20):
+                    sess.run(train_op, {x: inputs, y: outputs})
+                    steps += 1
+            except RuntimeError as e:
+                failed = str(e)
+            print('RESULT ' + json.dumps(
+                {'role': ROLE, 'steps': steps, 'failed': failed,
+                 'wait_s': time.time() - t0}), flush=True)
+    """)
+    results = launch_pair(tmp_path, body, timeout=300,
+                          extra_env={'AUTODIST_HEARTBEAT_TIMEOUT': '4'},
+                          require_result=(True, False))
+    chief = results[0]
+    assert 'missed heartbeats' in chief['failed'], chief
+    # ran ahead to the window edge (2 worker steps + staleness 2), then
+    # detected the death — well before any 600s gate timeout
+    assert chief['steps'] <= 4, chief
+    assert chief['wait_s'] < 120, chief
