@@ -105,6 +105,40 @@ class Session:
         # created lazily (fetch normalization, jit trace of Variable.read).
         self._built_node_count = self._user_node_count()
         self._init_state()
+        # liveness: peers judge us by our beat counter. A background
+        # beater decouples it from step cadence — a long XLA compile or
+        # an inter-run data-loading phase must not read as death.
+        self._hb_seen = {}
+        self._hb_peers = [
+            self._key('p%d' % i) for i in range(self._num_workers)
+            if i != ENV.AUTODIST_PROCESS_ID.val]
+        self._hb_stop = None
+        hb_timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
+        if self._loose and self._num_workers > 1 and hb_timeout:
+            import threading
+            self._hb_stop = threading.Event()
+            me = self._key(self._worker_name)
+            interval = min(hb_timeout / 4.0, 10.0)
+            stop = self._hb_stop
+
+            def beat_loop():
+                # own client: CoordClient sockets are not thread-safe
+                from autodist_tpu.runtime.coord_client import \
+                    connect_with_retry
+                try:
+                    client = connect_with_retry()
+                except Exception:   # noqa: BLE001 - liveness is advisory
+                    return
+                try:
+                    while not stop.wait(interval):
+                        client.heartbeat(me)
+                except OSError:
+                    pass
+                finally:
+                    client.close()
+
+            threading.Thread(target=beat_loop, daemon=True,
+                             name='autodist-heartbeat').start()
 
     def _user_node_count(self):
         return sum(1 for n in self._graph_item.graph.nodes
@@ -169,15 +203,11 @@ class Session:
         timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
         if not timeout:
             return
-        # a waiter is alive: refresh our own beat every gate slice so
-        # peers also blocked on the gate never declare US dead just
-        # because the wait outlasted the timeout
+        # belt and braces alongside the background beater: a waiter is
+        # trivially alive, refresh our beat on every gate slice too
         self._coord.heartbeat(self._key(self._worker_name))
-        names = [self._key('p%d' % i) for i in range(self._num_workers)
-                 if i != ENV.AUTODIST_PROCESS_ID.val]
-        if not hasattr(self, '_hb_seen'):
-            self._hb_seen = {}
-        dead = self._coord.dead_workers(names, timeout, self._hb_seen)
+        dead = self._coord.dead_workers(self._hb_peers, timeout,
+                                        self._hb_seen)
         if dead:
             raise RuntimeError(
                 'worker(s) %s missed heartbeats for > %.0fs while this '
@@ -544,6 +574,8 @@ class Session:
     # -- lifecycle ---------------------------------------------------------
     def close(self):
         self._closed = True
+        if getattr(self, '_hb_stop', None) is not None:
+            self._hb_stop.set()
 
     def __enter__(self):
         return self
