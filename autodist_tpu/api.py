@@ -575,6 +575,35 @@ class Trainer:
             tree, shardings)
         return state, got_step
 
+    # -- profiling (session path has RunOptions; this is the Trainer's) ----
+    def profile(self, state, batch, trace_dir, steps=3):
+        """Capture a ``jax.profiler`` trace (TensorBoard/Perfetto) of
+        ``steps`` compiled training steps — the functional-path analogue
+        of the session's ``RunOptions(trace_level=...)`` (reference
+        chrome-trace timelines, runner.py:64-75). Returns ``trace_dir``;
+        the traced steps' state updates are DISCARDED (profiling must
+        not perturb training)."""
+        import os
+        fn = self.compile_step(state, batch)
+        placed = self.shard_batch(batch)
+        # profile a COPY when the step donates its input state (the
+        # default): donating the caller's state would invalidate their
+        # buffers. Without donation the copy would only waste HBM.
+        s = jax.tree.map(jnp.copy, state) if self._donate else state
+        s, m = fn(s, placed)           # warmup outside the trace
+        jax.block_until_ready(m['loss'])
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            for _ in range(steps):
+                s, m = fn(s, placed)
+            jax.block_until_ready(m['loss'])
+        finally:
+            jax.profiler.stop_trace()
+        logging.info('Profiler trace (%d steps) written to %s',
+                     steps, trace_dir)
+        return trace_dir
+
     # -- fetch helpers (reference get-variable parity) ---------------------
     def get_params(self, state):
         """Gather params to host in logical (unsharded) layout."""

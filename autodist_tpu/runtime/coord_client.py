@@ -80,6 +80,10 @@ class CoordClient:
                 address = (host, int(port))
             else:
                 address = ('127.0.0.1', DEFAULT_COORD_PORT)
+        # the RESOLVED address, so sibling connections (e.g. a session's
+        # background heartbeat thread) dial exactly what worked here —
+        # the env address may differ (all-local runs rewrite to loopback)
+        self.address = address
         self._sock = socket.create_connection(address, timeout=timeout)
         self._buf = b''
 

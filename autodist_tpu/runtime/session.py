@@ -121,13 +121,21 @@ class Session:
             interval = min(hb_timeout / 4.0, 10.0)
             stop = self._hb_stop
 
+            # dial the address the MAIN client resolved (env may carry a
+            # NIC address that all-local runs rewrote to loopback)
+            coord_addr = getattr(self._coord, 'address', None)
+
             def beat_loop():
                 # own client: CoordClient sockets are not thread-safe
                 from autodist_tpu.runtime.coord_client import \
                     connect_with_retry
                 try:
-                    client = connect_with_retry()
+                    client = connect_with_retry(coord_addr)
                 except Exception:   # noqa: BLE001 - liveness is advisory
+                    logging.warning('heartbeat thread could not reach '
+                                    'the coord service at %s; liveness '
+                                    'falls back to per-run beats',
+                                    coord_addr)
                     return
                 try:
                     while not stop.wait(interval):

@@ -104,6 +104,9 @@ def main():
                         '(PS, PSLoadBalancing, PartitionedPS, AllReduce, '
                         'Parallax, ...) steering state shardings')
     p.add_argument('--data', default=None)
+    p.add_argument('--eval', action='store_true',
+                   help='after training, evaluate loss/accuracy in eval '
+                        'mode (BatchNorm running statistics)')
     args = p.parse_args()
 
     import jax
@@ -145,6 +148,17 @@ def main():
     print('%s: %.1f img/s (%.1f img/s/chip), loss=%.4f' %
           (args.model, args.steps * args.batch / dt,
            args.steps * args.batch / dt / n, loss))
+    if args.eval:
+        # eval mode: BatchNorm normalizes with the running statistics
+        # accumulated during the steps above (tf.layers moving averages)
+        def accuracy(params, b):
+            logits = model.apply(params, b['images'])
+            return {'acc': (logits.argmax(-1) == b['labels']).mean()}
+        eval_batch = batch if stream is None else next(stream)
+        metrics = trainer.evaluate(state, [eval_batch],
+                                   metrics_fn=accuracy)
+        print('eval (running stats): loss=%.4f acc=%.3f'
+              % (metrics['loss'], metrics['acc']))
 
 
 if __name__ == '__main__':
