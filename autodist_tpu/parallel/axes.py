@@ -78,7 +78,18 @@ class ParallelSpec:
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**d)
+        """Tolerates version skew in BOTH directions: missing fields
+        take their defaults (old dict, new code) and unknown fields are
+        dropped with a warning (new dict, old code) — a chief and its
+        workers need not run identical builds to exchange specs."""
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            from autodist_tpu.utils import logging
+            logging.warning('ParallelSpec.from_dict: dropping unknown '
+                            'fields %s (newer peer?)', sorted(unknown))
+        return cls(**{k: v for k, v in d.items() if k in known})
 
     def resolve_dp(self, n_devices):
         fixed = self.tp * self.pp * self.sp * self.ep

@@ -103,3 +103,23 @@ def test_dcn_mesh_runs_session_path():
         sess.run([loss, train_op], {x: inputs, y: outputs})
         b_val = sess.run([b])[0]
     np.testing.assert_allclose(b_val, 0.01 * 4.17503, atol=1e-5)
+
+
+def test_parallel_spec_dict_roundtrip_and_forward_compat():
+    """Chief-built specs ship to workers as dicts (Strategy-JSON
+    parity): round-trip preserves every field incl. dcn_dp, and dicts
+    from BEFORE a field existed still load (defaults fill in)."""
+    spec = ParallelSpec(dp=4, tp=2, dcn_dp=2, zero=2, grad_accum=2,
+                        sp_mode='ulysses')
+    d = spec.to_dict()
+    back = ParallelSpec.from_dict(d)
+    assert back.to_dict() == d
+    assert back.dcn_dp == 2 and back.sp_mode == 'ulysses'
+    old = {k: v for k, v in d.items() if k != 'dcn_dp'}   # pre-dcn dict
+    legacy = ParallelSpec.from_dict(old)
+    assert legacy.dcn_dp == 1 and legacy.dp == 4
+    # forward skew: a NEWER peer's dict with a field this build lacks
+    # must load too (unknown keys dropped), not TypeError
+    newer = dict(d, hypothetical_future_field=7)
+    skewed = ParallelSpec.from_dict(newer)
+    assert skewed.dp == 4 and skewed.dcn_dp == 2
