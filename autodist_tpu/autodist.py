@@ -205,6 +205,19 @@ class AutoDist:
         connect_host = '127.0.0.1' if all_local else host
         self._coord = coord_client.connect_with_retry(
             (connect_host, int(port)))
+        # PS data-plane endpoints (loose mode): every process brings up
+        # the endpoints local to ITS host (ensure_service is idempotent,
+        # so co-located processes race benignly) — endpoints on non-chief
+        # PS nodes are started by the worker process running there;
+        # variables land on the endpoint their reduction_destination maps
+        # to (session._ps_client_for) — the reference's
+        # one-tf.Server-per-PS-node layout (utils/server_starter.py:48-75).
+        for ep_host, ep_port in coord_client.ps_endpoints():
+            if is_local_address(ep_host):
+                proc = coord_client.ensure_service(
+                    ep_port, bind='127.0.0.1' if all_local else '0.0.0.0')
+                if proc is not None and not self._externally_launched:
+                    atexit.register(proc.terminate)
         if self._externally_launched and not ENV.AUTODIST_STRATEGY_ID.val:
             # Co-started processes (launch_cli / pod) exchange the
             # strategy through coord-service keys: clear any stale keys a

@@ -70,6 +70,16 @@ class ENV(Enum):
     # this many seconds is declared dead while we wait on the staleness
     # gate (0 disables). Keep it longer than the slowest expected step.
     AUTODIST_HEARTBEAT_TIMEOUT = (lambda v: float(v) if v else 60.0,)
+    # loose-mode PS data plane: comma-separated host:port list of PS
+    # endpoints (one coord-service instance each). Unset = single
+    # endpoint on the coord service itself. Variables land on the
+    # endpoint their strategy reduction_destination maps to — the
+    # multi-server placement the reference gets from one tf.Server per
+    # node (utils/server_starter.py:48-75).
+    AUTODIST_PS_ENDPOINTS = (lambda v: v if v else '',)
+    # wire dtype for PS tensor frames: f32 (default) or bf16 (half the
+    # bytes; values are rounded to bf16 on the wire, kept f32 at rest).
+    AUTODIST_PS_WIRE_DTYPE = (lambda v: v if v else 'f32',)
 
     @property
     def val(self):

@@ -1,4 +1,5 @@
-// Coordination service: TCP key/value + counters + barriers.
+// Coordination service: TCP key/value + counters + barriers + a binary
+// tensor data plane.
 //
 // TPU-native replacement for the control-plane primitives the reference
 // gets from the TF C++ runtime (SURVEY.md §2.2): FIFO token queues for
@@ -10,14 +11,28 @@
 // ahead only while min_step >= my_step - staleness), heartbeats for
 // fail-fast monitoring, and small metadata exchange (strategy ids).
 //
-// The tensor commands (VSET/VGET/VADD) are the PS data plane: the
-// reference aggregates cross-worker gradients in ConditionalAccumulators
-// living on the PS task (ps_synchronizer.py:556-633); here workers push
-// float32 deltas with an atomic elementwise VADD into host memory —
+// The binary tensor commands (BSET/BGET/BADD/BSTEP) are the PS data
+// plane: the reference aggregates cross-worker gradients in
+// ConditionalAccumulators living on the PS task and rides TF's grpc
+// data plane for the bytes (ps_synchronizer.py:556-633); here workers
+// push deltas/gradients as length-prefixed raw frames (f32 or bf16 on
+// the wire, f32 at rest) applied with an atomic elementwise add —
 // commutative apply-per-push, which is exactly the reference's
 // staleness>0 accumulator mode (take_grad(1): every push is applied).
+// Each tensor has its OWN mutex, so a multi-MB push on one variable
+// never serializes against another variable's pull; a run hosts one
+// service per PS endpoint (ps_lb_strategy.py:64-83 bin-packing made
+// load-bearing: variables land on the endpoint their
+// reduction_destination resolves to).
 //
-// Protocol: newline-terminated text commands over TCP.
+// BSTEP additionally keeps the optimizer step ON the PS (the reference
+// re-creates the optimizer over PS-resident variables so async workers
+// share slot state, kernel/partitioner.py:570-573): workers push raw
+// gradients and the service applies SGD/momentum with a PS-resident
+// velocity slot shared by all workers.
+//
+// Protocol: newline-terminated text commands over TCP; the B* commands
+// carry a length-prefixed raw payload immediately after the newline.
 //   SET <key> <value>            -> OK
 //   GET <key>                    -> VAL <value> | NONE
 //   DEL <key>                    -> OK
@@ -26,11 +41,15 @@
 //   MINWAIT <prefix> <n> <k> <ms>-> VAL <min> | TIMEOUT
 //       (wait until >=k keys share <prefix> and their min value >= n)
 //   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
-//   VSET <key> <b64>             -> OK   (store float32 tensor bytes)
-//   VGET <key>                   -> VAL <b64> | NONE
-//   VADD <key> <b64>             -> VAL <n>  (atomic elementwise += ;
-//                                   creates the tensor if absent; returns
-//                                   the tensor's accumulated push count)
+//   BSET <key> <nbytes> <wire>   [payload] -> OK
+//       (store tensor; wire dtype f32|bf16, stored as f32)
+//   BGET <key> <wire>            -> VAL <nbytes>\n[payload] | NONE
+//   BADD <key> <nbytes> <wire>   [payload] -> VAL <n>
+//       (atomic elementwise += ; creates the tensor if absent; returns
+//        the tensor's accumulated push count)
+//   BSTEP <key> <nbytes> <wire> <lr> <momentum> [payload] -> VAL <n>
+//       (payload is a GRADIENT; service applies vel = m*vel + g,
+//        tensor -= lr*vel with the velocity slot resident here)
 //   PING                         -> PONG
 //   SHUTDOWN                     -> OK (server exits)
 //
@@ -48,6 +67,7 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <sstream>
 #include <string>
@@ -56,6 +76,16 @@
 
 namespace {
 
+// A stored tensor. `mu` serializes element updates per KEY (not
+// globally): the scoped-allocator-scale concern of one global lock over
+// all variables does not exist here.
+struct Tensor {
+  std::mutex mu;
+  std::vector<float> data;
+  std::vector<float> vel;  // PS-resident momentum slot (BSTEP)
+  int64_t pushes = 0;
+};
+
 struct Store {
   std::mutex mu;
   std::condition_variable cv;
@@ -63,60 +93,77 @@ struct Store {
   std::map<std::string, int64_t> counters;
   std::map<std::string, int64_t> barrier_arrivals;
   std::map<std::string, int64_t> barrier_generation;
-  std::map<std::string, std::vector<float>> tensors;
-  std::map<std::string, int64_t> tensor_pushes;
+  std::map<std::string, std::shared_ptr<Tensor>> tensors;
   std::atomic<bool> shutting_down{false};
 };
 
 Store g_store;
 
-// -- base64 (payloads for the tensor commands) ------------------------------
-
-const char kB64[] =
-    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
-
-std::string b64_encode(const unsigned char* data, size_t len) {
-  std::string out;
-  out.reserve((len + 2) / 3 * 4);
-  for (size_t i = 0; i < len; i += 3) {
-    uint32_t v = data[i] << 16;
-    if (i + 1 < len) v |= data[i + 1] << 8;
-    if (i + 2 < len) v |= data[i + 2];
-    out.push_back(kB64[(v >> 18) & 63]);
-    out.push_back(kB64[(v >> 12) & 63]);
-    out.push_back(i + 1 < len ? kB64[(v >> 6) & 63] : '=');
-    out.push_back(i + 2 < len ? kB64[v & 63] : '=');
-  }
-  return out;
+std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
+  std::lock_guard<std::mutex> l(g_store.mu);
+  auto it = g_store.tensors.find(key);
+  if (it != g_store.tensors.end()) return it->second;
+  if (!create) return nullptr;
+  auto t = std::make_shared<Tensor>();
+  g_store.tensors[key] = t;
+  return t;
 }
 
-struct B64Rev {
-  int rev[256];
-  B64Rev() {
-    for (int i = 0; i < 256; ++i) rev[i] = -1;
-    for (int i = 0; i < 64; ++i) rev[static_cast<int>(kB64[i])] = i;
-  }
-};
-// initialized before main(): connection threads share it read-only
-const B64Rev g_b64rev;
+// -- wire dtypes -------------------------------------------------------------
 
-bool b64_decode(const std::string& in, std::vector<unsigned char>* out) {
-  const int* rev = g_b64rev.rev;
-  out->clear();
-  uint32_t v = 0;
-  int bits = 0;
-  for (char c : in) {
-    if (c == '=') break;
-    int d = rev[static_cast<unsigned char>(c)];
-    if (d < 0) return false;
-    v = (v << 6) | d;
-    bits += 6;
-    if (bits >= 8) {
-      bits -= 8;
-      out->push_back((v >> bits) & 0xff);
-    }
+uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  // NaN first: rtne rounding would carry a small-mantissa NaN into Inf
+  if ((u & 0x7fffffffu) > 0x7f800000u)
+    return static_cast<uint16_t>((u >> 16) | 0x0040);  // quiet NaN
+  // round-to-nearest-even, like XLA's f32->bf16 convert
+  uint32_t bias = 0x7fff + ((u >> 16) & 1);
+  return static_cast<uint16_t>((u + bias) >> 16);
+}
+
+float bf16_to_f32(uint16_t h) {
+  uint32_t u = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+// wire "f32": payload is raw little-endian float32; "bf16": raw uint16
+// upper halves of float32. Returns false on a malformed payload.
+bool decode_wire(const std::string& payload, const std::string& wire,
+                 std::vector<float>* out) {
+  if (wire == "f32") {
+    if (payload.size() % 4) return false;
+    out->resize(payload.size() / 4);
+    memcpy(out->data(), payload.data(), payload.size());
+    return true;
   }
-  return true;
+  if (wire == "bf16") {
+    if (payload.size() % 2) return false;
+    size_t n = payload.size() / 2;
+    out->resize(n);
+    const uint16_t* src =
+        reinterpret_cast<const uint16_t*>(payload.data());
+    for (size_t i = 0; i < n; ++i) (*out)[i] = bf16_to_f32(src[i]);
+    return true;
+  }
+  return false;
+}
+
+bool encode_wire(const std::vector<float>& v, const std::string& wire,
+                 std::string* out) {
+  if (wire == "f32") {
+    out->assign(reinterpret_cast<const char*>(v.data()), v.size() * 4);
+    return true;
+  }
+  if (wire == "bf16") {
+    out->resize(v.size() * 2);
+    uint16_t* dst = reinterpret_cast<uint16_t*>(&(*out)[0]);
+    for (size_t i = 0; i < v.size(); ++i) dst[i] = f32_to_bf16(v[i]);
+    return true;
+  }
+  return false;
 }
 
 int64_t counter_of(const std::string& key) {
@@ -139,7 +186,22 @@ int64_t prefix_min(const std::string& prefix, int* count) {
   return n ? min_v : 0;
 }
 
-std::string handle(const std::string& line) {
+// Payload bytes that follow the header line, or 0 for text commands.
+size_t payload_size(const std::string& line) {
+  std::istringstream in(line);
+  std::string cmd, key;
+  in >> cmd;
+  if (cmd != "BSET" && cmd != "BADD" && cmd != "BSTEP") return 0;
+  size_t nbytes = 0;
+  in >> key >> nbytes;
+  return nbytes;
+}
+
+// Handles one request. `payload` holds the request's raw bytes (B*
+// commands); a BGET reply's bytes land in `reply_payload` and follow the
+// returned header line on the wire.
+std::string handle(const std::string& line, const std::string& payload,
+                   std::string* reply_payload) {
   std::istringstream in(line);
   std::string cmd;
   in >> cmd;
@@ -231,50 +293,71 @@ std::string handle(const std::string& line) {
     }
     return "TIMEOUT";
   }
-  if (cmd == "VSET") {
-    std::string k, b64;
-    in >> k >> b64;
-    std::vector<unsigned char> bytes;
-    if (!b64_decode(b64, &bytes) || bytes.size() % sizeof(float) != 0)
-      return "ERR bad payload";
-    std::lock_guard<std::mutex> l(g_store.mu);
-    std::vector<float>& t = g_store.tensors[k];
-    t.assign(bytes.size() / sizeof(float), 0.f);
-    memcpy(t.data(), bytes.data(), bytes.size());
-    g_store.tensor_pushes[k] = 0;
-    g_store.cv.notify_all();
+  if (cmd == "BSET") {
+    std::string k, wire;
+    size_t nbytes = 0;
+    in >> k >> nbytes >> wire;
+    std::vector<float> vals;
+    if (!decode_wire(payload, wire, &vals)) return "ERR bad payload";
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
+    std::lock_guard<std::mutex> l(t->mu);
+    t->data = std::move(vals);
+    t->vel.clear();
+    t->pushes = 0;
     return "OK";
   }
-  if (cmd == "VGET") {
-    std::string k;
-    in >> k;
-    std::vector<float> snapshot;
+  if (cmd == "BGET") {
+    std::string k, wire;
+    in >> k >> wire;
+    if (wire.empty()) wire = "f32";
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
+    if (!t) return "NONE";
     {
-      std::lock_guard<std::mutex> l(g_store.mu);
-      auto it = g_store.tensors.find(k);
-      if (it == g_store.tensors.end()) return "NONE";
-      snapshot = it->second;  // copy under lock, encode outside it
+      std::lock_guard<std::mutex> l(t->mu);
+      if (!encode_wire(t->data, wire, reply_payload))
+        return "ERR bad wire dtype";
     }
-    return "VAL " + b64_encode(
-        reinterpret_cast<const unsigned char*>(snapshot.data()),
-        snapshot.size() * sizeof(float));
+    return "VAL " + std::to_string(reply_payload->size());
   }
-  if (cmd == "VADD") {
-    std::string k, b64;
-    in >> k >> b64;
-    std::vector<unsigned char> bytes;
-    if (!b64_decode(b64, &bytes) || bytes.size() % sizeof(float) != 0)
-      return "ERR bad payload";
-    size_t n = bytes.size() / sizeof(float);
-    const float* delta = reinterpret_cast<const float*>(bytes.data());
-    std::lock_guard<std::mutex> l(g_store.mu);
-    std::vector<float>& t = g_store.tensors[k];
-    if (t.empty()) t.assign(n, 0.f);
-    if (t.size() != n) return "ERR shape mismatch";
-    for (size_t i = 0; i < n; ++i) t[i] += delta[i];
-    int64_t pushes = ++g_store.tensor_pushes[k];
-    g_store.cv.notify_all();
-    return "VAL " + std::to_string(pushes);
+  if (cmd == "BADD") {
+    std::string k, wire;
+    size_t nbytes = 0;
+    in >> k >> nbytes >> wire;
+    std::vector<float> delta;
+    if (!decode_wire(payload, wire, &delta)) return "ERR bad payload";
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
+    std::lock_guard<std::mutex> l(t->mu);
+    if (t->data.empty()) t->data.assign(delta.size(), 0.f);
+    if (t->data.size() != delta.size()) return "ERR shape mismatch";
+    for (size_t i = 0; i < delta.size(); ++i) t->data[i] += delta[i];
+    return "VAL " + std::to_string(++t->pushes);
+  }
+  if (cmd == "BSTEP") {
+    std::string k, wire;
+    size_t nbytes = 0;
+    double lr = 0.0, momentum = 0.0;
+    in >> k >> nbytes >> wire >> lr >> momentum;
+    std::vector<float> grad;
+    if (!decode_wire(payload, wire, &grad)) return "ERR bad payload";
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
+    if (!t) return "ERR no tensor";
+    std::lock_guard<std::mutex> l(t->mu);
+    if (t->data.size() != grad.size()) return "ERR shape mismatch";
+    if (momentum != 0.0 && t->vel.empty())
+      t->vel.assign(grad.size(), 0.f);
+    if (momentum != 0.0) {
+      const float m = static_cast<float>(momentum);
+      const float a = static_cast<float>(lr);
+      for (size_t i = 0; i < grad.size(); ++i) {
+        t->vel[i] = m * t->vel[i] + grad[i];
+        t->data[i] -= a * t->vel[i];
+      }
+    } else {
+      const float a = static_cast<float>(lr);
+      for (size_t i = 0; i < grad.size(); ++i)
+        t->data[i] -= a * grad[i];
+    }
+    return "VAL " + std::to_string(++t->pushes);
   }
   if (cmd == "SHUTDOWN") {
     std::lock_guard<std::mutex> l(g_store.mu);
@@ -285,27 +368,56 @@ std::string handle(const std::string& line) {
   return "ERR unknown command";
 }
 
+bool send_all(int fd, const char* data, size_t len) {
+  while (len) {
+    ssize_t n = send(fd, data, len, 0);
+    if (n <= 0) return false;
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
 void serve_conn(int fd) {
   std::string buf;
-  char chunk[4096];
+  char chunk[1 << 16];
   while (!g_store.shutting_down) {
-    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
-    if (n <= 0) break;
-    buf.append(chunk, n);
+    // one header line
     size_t pos;
-    while ((pos = buf.find('\n')) != std::string::npos) {
-      std::string line = buf.substr(0, pos);
-      buf.erase(0, pos + 1);
-      if (!line.empty() && line.back() == '\r') line.pop_back();
-      std::string resp = handle(line) + "\n";
-      if (send(fd, resp.data(), resp.size(), 0) < 0) {
+    while ((pos = buf.find('\n')) == std::string::npos) {
+      ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
         close(fd);
         return;
       }
-      if (g_store.shutting_down) {  // reply sent; exit promptly —
-        close(fd);                  // accept() would otherwise block
-        _exit(0);
+      buf.append(chunk, n);
+    }
+    std::string line = buf.substr(0, pos);
+    buf.erase(0, pos + 1);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    // then that command's declared payload bytes
+    size_t need = payload_size(line);
+    while (buf.size() < need) {
+      ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        close(fd);
+        return;
       }
+      buf.append(chunk, n);
+    }
+    std::string payload = buf.substr(0, need);
+    buf.erase(0, need);
+    std::string reply_payload;
+    std::string resp = handle(line, payload, &reply_payload) + "\n";
+    if (!send_all(fd, resp.data(), resp.size()) ||
+        (!reply_payload.empty() &&
+         !send_all(fd, reply_payload.data(), reply_payload.size()))) {
+      close(fd);
+      return;
+    }
+    if (g_store.shutting_down) {  // reply sent; exit promptly —
+      close(fd);                  // accept() would otherwise block
+      _exit(0);
     }
   }
   close(fd);

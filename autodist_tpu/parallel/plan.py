@@ -438,8 +438,9 @@ class ExecutionPlan:
             lines.append(
                 '  (PS reduction destinations are advisory under SPMD: '
                 'state shards over the mesh, collectives replace '
-                'push/pull; destinations matter for loose-mode PS '
-                'placement and capacity planning only)')
+                'push/pull. In loose mode they are load-bearing: each '
+                'variable lives on the PS endpoint its destination maps '
+                'to — session._init_ps_endpoints)')
         for name, p in self.var_plans.items():
             kind = 'AllReduce' if p.is_ar else 'PS'
             extra = ''

@@ -12,8 +12,14 @@ the c9 timing contract): each worker publishes its step counter under
 :meth:`staleness_gate`, which blocks until ``min(all steps) >= s -
 staleness``. A fast worker can thus run at most ``staleness`` steps ahead
 — the queue-capacity semantics without TF FIFO queues.
+
+The tensor data plane (:meth:`CoordClient.vset` / ``vget`` / ``vadd`` /
+``vstep``) speaks length-prefixed binary frames: a text header line
+declaring the byte count, then the raw tensor bytes — f32 or bf16 on the
+wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at rest on the service. This is
+the grpc-data-plane equivalent the reference rode for PS traffic; base64
+text framing (33% inflation, full-line buffering) is gone.
 """
-import base64
 import socket
 import subprocess
 import time
@@ -22,6 +28,39 @@ import numpy as np
 
 from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
 from autodist_tpu.utils import logging
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+
+def _wire_dtype(wire=None):
+    """Resolve the wire dtype name ('f32'|'bf16')."""
+    wire = wire or ENV.AUTODIST_PS_WIRE_DTYPE.val
+    if wire not in ('f32', 'bf16'):
+        raise ValueError('unsupported PS wire dtype %r' % wire)
+    if wire == 'bf16' and _BF16 is None:  # pragma: no cover
+        logging.warning('bf16 wire requested but ml_dtypes is missing; '
+                        'falling back to f32')
+        return 'f32'
+    return wire
+
+
+def _encode(arr, wire):
+    """float32 host array -> raw wire bytes."""
+    arr = np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    if wire == 'bf16':
+        return arr.astype(_BF16).tobytes()
+    return arr.tobytes()
+
+
+def _decode(raw, wire):
+    """Raw wire bytes -> float32 host array."""
+    if wire == 'bf16':
+        return np.frombuffer(raw, dtype=_BF16).astype(np.float32)
+    return np.frombuffer(raw, dtype=np.float32)
 
 
 def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
@@ -50,6 +89,29 @@ def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
         except OSError:
             time.sleep(0.05)
     raise RuntimeError('coord_service failed to start on :%d' % port)
+
+
+def ps_endpoints():
+    """Configured PS data-plane endpoints as (host, port) tuples.
+
+    Empty when ``AUTODIST_PS_ENDPOINTS`` is unset — the single-endpoint
+    layout where variables live on the coord service itself.
+    """
+    raw = ENV.AUTODIST_PS_ENDPOINTS.val
+    if not raw:
+        return []
+    eps = []
+    for item in raw.split(','):
+        item = item.strip()
+        if not item:   # tolerate trailing commas / blank entries
+            continue
+        if ':' not in item:
+            raise ValueError(
+                'AUTODIST_PS_ENDPOINTS entries must be host:port; got %r'
+                % item)
+        host, port = item.rsplit(':', 1)
+        eps.append((host, int(port)))
+    return eps
 
 
 def connect_with_retry(address=None, deadline_s=30.0):
@@ -85,17 +147,48 @@ class CoordClient:
         # the env address may differ (all-local runs rewrite to loopback)
         self.address = address
         self._sock = socket.create_connection(address, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b''
 
-    def _rpc(self, line):
-        self._sock.sendall(line.encode() + b'\n')
+    def _rpc(self, line, payload=None):
+        """Send one request (header line + optional raw payload), read the
+        reply header line."""
+        header = line.encode() + b'\n'
+        if payload and len(payload) > 65536:
+            # large tensor frames: send header + payload separately to
+            # avoid a whole-payload concat copy (TCP_NODELAY is set, and
+            # the payload write follows immediately, so no Nagle stall)
+            self._sock.sendall(header)
+            self._sock.sendall(payload)
+        else:
+            self._sock.sendall(header + payload if payload else header)
         while b'\n' not in self._buf:
-            chunk = self._sock.recv(4096)
+            chunk = self._sock.recv(65536)
             if not chunk:
                 raise OSError('coord_service closed connection')
             self._buf += chunk
         resp, self._buf = self._buf.split(b'\n', 1)
         return resp.decode()
+
+    def _read_exact(self, nbytes):
+        """Read exactly ``nbytes`` of reply payload (after a VAL header)."""
+        parts = []
+        have = len(self._buf)
+        if have:
+            take = min(have, nbytes)
+            parts.append(self._buf[:take])
+            self._buf = self._buf[take:]
+            nbytes -= take
+        while nbytes:
+            chunk = self._sock.recv(min(nbytes, 1 << 20))
+            if not chunk:
+                raise OSError('coord_service closed connection')
+            if len(chunk) > nbytes:  # pragma: no cover - server never
+                self._buf += chunk[nbytes:]  # pipelines replies
+                chunk = chunk[:nbytes]
+            parts.append(chunk)
+            nbytes -= len(chunk)
+        return b''.join(parts)
 
     # -- primitives --------------------------------------------------------
     def ping(self):
@@ -148,34 +241,54 @@ class CoordClient:
             pass
 
     # -- tensor data plane (PS accumulator equivalent) ---------------------
-    def vset(self, key, value):
-        """Store a float32 tensor (authoritative PS copy)."""
-        arr = np.ascontiguousarray(np.asarray(value, dtype=np.float32))
-        payload = base64.b64encode(arr.tobytes()).decode()
-        resp = self._rpc('VSET %s %s' % (key, payload))
+    def vset(self, key, value, wire=None):
+        """Store a tensor (authoritative PS copy). Stored f32; wire dtype
+        per ``AUTODIST_PS_WIRE_DTYPE``."""
+        wire = _wire_dtype(wire)
+        payload = _encode(value, wire)
+        resp = self._rpc('BSET %s %d %s' % (key, len(payload), wire),
+                         payload)
         if resp != 'OK':
-            raise OSError('VSET %s failed: %s' % (key, resp))
+            raise OSError('BSET %s failed: %s' % (key, resp))
 
-    def vget(self, key, shape=None, dtype=np.float32):
-        """Fetch a float32 tensor, or None if absent."""
-        resp = self._rpc('VGET %s' % key)
+    def vget(self, key, shape=None, dtype=np.float32, wire=None):
+        """Fetch a tensor as float32 host array, or None if absent."""
+        wire = _wire_dtype(wire)
+        resp = self._rpc('BGET %s %s' % (key, wire))
         if resp == 'NONE':
             return None
-        arr = np.frombuffer(base64.b64decode(resp[4:]), dtype=np.float32)
+        if not resp.startswith('VAL'):
+            raise OSError('BGET %s failed: %s' % (key, resp))
+        arr = _decode(self._read_exact(int(resp[4:])), wire)
         if shape is not None:
             arr = arr.reshape(shape)
         return arr.astype(dtype, copy=False)
 
-    def vadd(self, key, delta):
-        """Atomically add a float32 delta elementwise (apply-per-push,
-        the reference's staleness-mode ConditionalAccumulator semantics,
+    def vadd(self, key, delta, wire=None):
+        """Atomically add a delta elementwise (apply-per-push, the
+        reference's staleness-mode ConditionalAccumulator semantics,
         ps_synchronizer.py:556-633 with num_required=1). Returns the
         tensor's total push count."""
-        arr = np.ascontiguousarray(np.asarray(delta, dtype=np.float32))
-        payload = base64.b64encode(arr.tobytes()).decode()
-        resp = self._rpc('VADD %s %s' % (key, payload))
+        wire = _wire_dtype(wire)
+        payload = _encode(delta, wire)
+        resp = self._rpc('BADD %s %d %s' % (key, len(payload), wire),
+                         payload)
         if not resp.startswith('VAL'):
-            raise OSError('VADD %s failed: %s' % (key, resp))
+            raise OSError('BADD %s failed: %s' % (key, resp))
+        return int(resp[4:])
+
+    def vstep(self, key, grad, lr, momentum=0.0, wire=None):
+        """Push a raw GRADIENT; the service applies the SGD/momentum
+        update with a PS-resident velocity slot shared by all workers
+        (the reference's PS-resident optimizer, partitioner.py:570-573 /
+        ps_synchronizer.py:175-176). Returns the push count."""
+        wire = _wire_dtype(wire)
+        payload = _encode(grad, wire)
+        resp = self._rpc('BSTEP %s %d %s %.17g %.17g'
+                         % (key, len(payload), wire, lr, momentum),
+                         payload)
+        if not resp.startswith('VAL'):
+            raise OSError('BSTEP %s failed: %s' % (key, resp))
         return int(resp[4:])
 
     def wait_key(self, key, timeout_s=60.0, poll_s=0.05):

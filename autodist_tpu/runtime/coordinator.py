@@ -25,6 +25,7 @@ from autodist_tpu.utils import logging
 _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_COORD_SERVICE_ADDR,
                     ENV.AUTODIST_HEARTBEAT_TIMEOUT,
+                    ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 

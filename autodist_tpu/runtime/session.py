@@ -94,6 +94,14 @@ class Session:
                                for s in p.all_syncs)}
         self._proxy_cache = {}
         self._proxy_hits = 0
+        # loose-mode PS data plane: one client per endpoint, variables
+        # placed by reduction_destination (multi-server PS)
+        self._ps_clients = []
+        self._ps_index = {}
+        self._ps_bytes = 0
+        self._ps_seconds = 0.0
+        if self._loose:
+            self._init_ps_endpoints()
         if self._proxy_vars and not self._loose:
             logging.info(
                 'local_proxy_variable on %d vars: subsumed by SPMD '
@@ -222,6 +230,111 @@ class Session:
                 'process waited on the staleness gate — failing fast '
                 'instead of hanging' % (sorted(dead), timeout))
 
+    # -- loose-mode PS endpoint placement ----------------------------------
+    def _init_ps_endpoints(self):
+        """Connect the PS data plane. With ``AUTODIST_PS_ENDPOINTS`` set,
+        each variable is served by the endpoint its strategy
+        ``reduction_destination`` maps to — host match first (endpoints
+        co-located with PS nodes), else the destination's ordinal among
+        the distinct destinations — so PSLoadBalancing's byte-size
+        bin-packing (reference ps_lb_strategy.py:64-83) decides real
+        runtime placement, like the reference's one tf.Server per PS node
+        (utils/server_starter.py:48-75). Without endpoints, all variables
+        live on the coord service (single-PS layout)."""
+        from autodist_tpu.runtime import coord_client as cc
+        eps = cc.ps_endpoints()
+        if not eps:
+            self._ps_clients = [self._coord]
+            return
+        self._ps_clients = [cc.connect_with_retry(ep) for ep in eps]
+        n = len(eps)
+        hosts = [h for h, _ in eps]
+        dests = sorted({
+            getattr(p.sync, 'reduction_destination', '')
+            for p in self._plan.var_plans.values()
+            if p.is_ps and getattr(p.sync, 'reduction_destination', '')})
+        dest_ord = {d: i for i, d in enumerate(dests)}
+        for name, p in self._plan.var_plans.items():
+            dest = getattr(p.sync, 'reduction_destination', '') \
+                if p.is_ps else ''
+            if dest:
+                dhost = dest.split(':', 1)[0]
+                # endpoints co-located on the destination's host; when a
+                # host runs several, spread destinations across them
+                cands = [i for i, h in enumerate(hosts) if h == dhost]
+                if cands:
+                    idx = cands[dest_ord[dest] % len(cands)]
+                else:
+                    idx = dest_ord[dest] % n
+            else:
+                idx = self._stable_idx(name, n)
+            self._ps_index[name] = idx
+        counts = [sum(1 for i in self._ps_index.values() if i == k)
+                  for k in range(n)]
+        logging.info('PS data plane: %d endpoints, variables per '
+                     'endpoint %s', n, counts)
+
+    @staticmethod
+    def _stable_idx(name, n):
+        import zlib
+        return zlib.crc32(name.encode()) % n
+
+    def _ps_client_for(self, name):
+        idx = self._ps_index.get(name)
+        if idx is None:
+            idx = self._stable_idx(name, len(self._ps_clients))
+            self._ps_index[name] = idx
+        return self._ps_clients[idx]
+
+    def _ps_transfer(self, names, fn):
+        """Run ``fn(client, name)`` for every name; names grouped by
+        endpoint, endpoint groups in parallel threads. Each endpoint's
+        socket is used by exactly one thread (CoordClient sockets are
+        not thread-safe), so multi-endpoint pulls/pushes overlap across
+        PS servers like the reference's concurrent grpc channels."""
+        groups = {}
+        for name in names:
+            self._ps_client_for(name)
+            groups.setdefault(self._ps_index[name], []).append(name)
+        results = {}
+        if len(groups) <= 1:
+            for idx, grp in groups.items():
+                client = self._ps_clients[idx]
+                for name in grp:
+                    results[name] = fn(client, name)
+            return results
+        import threading
+        lock = threading.Lock()
+        errs = []
+
+        def work(idx, grp):
+            client = self._ps_clients[idx]
+            try:
+                for name in grp:
+                    r = fn(client, name)
+                    with lock:
+                        results[name] = r
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=work, args=(i, g))
+                   for i, g in groups.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return results
+
+    @property
+    def ps_stats(self):
+        """Loose-mode wire accounting: payload bytes moved and seconds
+        spent on PS pulls+pushes (the measured per-step PS overhead)."""
+        return {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
+                'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
+                             if self._ps_seconds else 0.0)}
+
     # -- multi-process placement helpers ----------------------------------
     def _put(self, value, sharding):
         """Place a host value that is logically global (same on every
@@ -273,20 +386,26 @@ class Session:
                 self._graph_item.graph.variables[n].init_value = \
                     np.asarray(v)
         if self._loose:
-            # chief seeds the authoritative PS copies on the coord service
+            variables = self._graph_item.graph.variables
+            # chief seeds the authoritative PS copies across endpoints
             if self._is_chief:
-                for name, var in self._graph_item.graph.variables.items():
-                    self._coord.vset(self._key('var/%s' % name),
-                                     np.asarray(var.init_value))
+                self._ps_transfer(
+                    list(variables),
+                    lambda c, name: c.vset(
+                        self._key('var/%s' % name),
+                        np.asarray(variables[name].init_value)))
             # heartbeat baseline BEFORE the barrier: once any gate runs,
             # every peer has a timestamp (a missing one reads as dead)
             self._coord.heartbeat(self._key(self._worker_name))
             self._coord.barrier(self._key('session/init'),
                                 self._num_workers, timeout_s=120.0)
             if not self._is_chief:
-                for name, var in self._graph_item.graph.variables.items():
-                    served = self._coord.vget(self._key('var/%s' % name),
-                                              shape=var.shape)
+                served_map = self._ps_transfer(
+                    list(variables),
+                    lambda c, name: c.vget(self._key('var/%s' % name),
+                                           shape=variables[name].shape))
+                for name, served in served_map.items():
+                    var = variables[name]
                     var.init_value = served.astype(var.init_value.dtype)
         self._var_state = {}
         for name, var in self._graph_item.graph.variables.items():
@@ -442,47 +561,79 @@ class Session:
         return results[0] if single else results
 
     # -- loose-mode PS data plane -----------------------------------------
+    def _wire_nbytes(self, n_elems):
+        from autodist_tpu.runtime.coord_client import _wire_dtype
+        return n_elems * (2 if _wire_dtype() == 'bf16' else 4)
+
     def _pull_ps_vars(self):
-        """Refresh variable state from the authoritative coord-service
-        copies (the worker's per-step PS read). Returns the pulled host
-        values for delta computation."""
+        """Refresh variable state from the authoritative PS copies (the
+        worker's per-step PS read), endpoints pulled in parallel.
+        Returns the pulled host values for delta computation."""
+        import time as _time
+        t0 = _time.perf_counter()
+        variables = self._graph_item.graph.variables
+        to_fetch = [name for name in variables
+                    if not (name in self._proxy_vars and
+                            name in self._proxy_cache)]
+        fetched = self._ps_transfer(
+            to_fetch,
+            lambda c, name: c.vget(self._key('var/%s' % name),
+                                   shape=variables[name].shape))
         pulled = {}
-        for name, var in self._graph_item.graph.variables.items():
-            if name in self._proxy_vars and name in self._proxy_cache:
-                # proxy read: serve from the local cache, no PS round-trip
-                # on the pre-step critical path
-                served = self._proxy_cache[name]
-                self._proxy_hits += 1
-            else:
-                served = self._coord.vget(self._key('var/%s' % name),
-                                          shape=var.shape)
+        n_elems = 0
+        for name, var in variables.items():
+            if name in fetched:
+                served = fetched[name]
+                n_elems += int(np.prod(var.shape)) if var.shape else 1
                 if served is None:  # pragma: no cover - init barrier
                     served = np.asarray(var.init_value, dtype=np.float32)
                 served = served.astype(var.init_value.dtype)
+            else:
+                # proxy read: serve from the local cache, no PS
+                # round-trip on the pre-step critical path
+                served = self._proxy_cache[name]
+                self._proxy_hits += 1
             pulled[name] = served
             self._var_state[name] = self._put(
                 self._plan.pad_host(name, jnp.asarray(served)),
                 self._plan.var_sharding(name))
+        self._ps_seconds += _time.perf_counter() - t0
+        self._ps_bytes += self._wire_nbytes(n_elems)
         return pulled
 
     def _push_ps_deltas(self, pulled):
-        """Push ``new - pulled`` per variable: VADD is commutative, so
-        concurrent workers' updates accumulate exactly like the
-        reference's apply-per-push accumulators."""
-        for name, before in pulled.items():
-            after = self._local_value(name)
-            delta = np.asarray(after, dtype=np.float32) - \
-                np.asarray(before, dtype=np.float32)
-            self._coord.vadd(self._key('var/%s' % name), delta)
-        for name in self._proxy_vars:
-            # post-update assign (proxy_variable.py:163-190): refresh the
-            # proxy from the PS after the push, off the pre-step path
-            var = self._graph_item.var_by_name(name)
-            served = self._coord.vget(self._key('var/%s' % name),
-                                      shape=var.shape)
+        """Push ``new - pulled`` per variable: the binary BADD is
+        commutative, so concurrent workers' updates accumulate exactly
+        like the reference's apply-per-push accumulators. Endpoint
+        groups push in parallel."""
+        import time as _time
+        t0 = _time.perf_counter()
+        afters = {name: np.asarray(self._local_value(name),
+                                   dtype=np.float32)
+                  for name in pulled}
+
+        def push(client, name):
+            delta = afters[name] - np.asarray(pulled[name],
+                                              dtype=np.float32)
+            client.vadd(self._key('var/%s' % name), delta)
+
+        self._ps_transfer(list(pulled), push)
+        n_elems = sum(a.size for a in afters.values())
+        # post-update assign (proxy_variable.py:163-190): refresh the
+        # proxy from the PS after the push, off the pre-step path
+        refreshed = self._ps_transfer(
+            list(self._proxy_vars),
+            lambda c, name: c.vget(
+                self._key('var/%s' % name),
+                shape=self._graph_item.var_by_name(name).shape))
+        for name, served in refreshed.items():
             if served is not None:
+                var = self._graph_item.var_by_name(name)
                 self._proxy_cache[name] = \
                     served.astype(var.init_value.dtype)
+                n_elems += served.size
+        self._ps_seconds += _time.perf_counter() - t0
+        self._ps_bytes += self._wire_nbytes(n_elems)
 
     def _contract(self, fetch, stacked, split_sizes):
         """Apply the reference fetch contract to the per-replica stack."""
@@ -613,10 +764,10 @@ class Session:
     def get_variable_value(self, var):
         name = var.name if isinstance(var, fe.Variable) else var
         if self._loose:
-            # authoritative copy lives on the coord-service PS
+            # authoritative copy lives on the variable's PS endpoint
             var_obj = self._graph_item.var_by_name(name)
-            served = self._coord.vget(self._key('var/%s' % name),
-                                      shape=var_obj.shape)
+            served = self._ps_client_for(name).vget(
+                self._key('var/%s' % name), shape=var_obj.shape)
             return served.astype(var_obj.init_value.dtype)
         return self._local_value(name)
 
@@ -626,4 +777,5 @@ class Session:
             self._plan.pad_host(name, jnp.asarray(value)),
             self._plan.var_sharding(name))
         if self._loose and self._is_chief:
-            self._coord.vset(self._key('var/%s' % name), np.asarray(value))
+            self._ps_client_for(name).vset(self._key('var/%s' % name),
+                                           np.asarray(value))
