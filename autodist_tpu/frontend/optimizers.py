@@ -30,6 +30,12 @@ class Optimizer:
     PartitionSpec as the variable itself (ZeRO-style PS realization).
     """
 
+    # SGD-family optimizers publish their scalar hyperparameters here so
+    # loose-mode PS sessions can run the update step ON the PS with
+    # shared slot state (coord_service BSTEP); None = PS-side apply
+    # unsupported, worker-local slots are used.
+    ps_step_params = None
+
     def __init__(self, tx, name=None, _capture=None):
         self.uid = 'opt_%d' % next(_UID)
         self.tx = tx
@@ -92,6 +98,11 @@ class SGD(Optimizer):
                       nesterov=nesterov),
             name, _capture=('SGD', (learning_rate,),
                             {'momentum': momentum, 'nesterov': nesterov}))
+        if not nesterov and isinstance(learning_rate, (int, float)):
+            # BSTEP implements vel = m*vel + g; w -= lr*vel (optax.sgd's
+            # trace form); nesterov variants stay worker-local
+            self.ps_step_params = {'lr': float(learning_rate),
+                                   'momentum': float(momentum)}
 
 
 GradientDescent = SGD

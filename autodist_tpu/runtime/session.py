@@ -94,6 +94,14 @@ class Session:
                                for s in p.all_syncs)}
         self._proxy_cache = {}
         self._proxy_hits = 0
+        # PS-resident optimizer (reference partitioner.py:570-573): vars
+        # whose strategy asks for service-side updates with shared slots
+        self._shared_opt_vars = {
+            name for name, p in plan.var_plans.items()
+            if p.is_ps and any(getattr(s, 'shared_optimizer', False)
+                               for s in p.all_syncs)}
+        self._shared_warned = set()
+        self._shared_pushes = 0
         # loose-mode PS data plane: one client per endpoint, variables
         # placed by reduction_destination (multi-server PS)
         self._ps_clients = []
@@ -414,10 +422,19 @@ class Session:
                 plan.var_sharding(name))
         self._opt_state = {}
         self._refresh_opt_state()
-        # NB: in loose mode optimizer slots are worker-local by design —
-        # the reference shares slots on the PS, but concurrent slot updates
-        # under relaxed consistency are racy there too; device-local slots
-        # are the TPU-native choice.
+        # Loose-mode optimizer slots: worker-local by default (the
+        # device-local TPU-native choice), or PS-resident and shared via
+        # the strategy's shared_optimizer flag — the reference's
+        # semantics, where the optimizer is re-created over PS-resident
+        # variables (kernel/partitioner.py:570-573) and the update op
+        # runs on the PS (ps_synchronizer.py:175-176). Shared mode ships
+        # raw gradients (BSTEP); the divergence is real and measured:
+        # 2 workers x 5 momentum(0.9) steps on c0-style data moved b to
+        # 1.477 (shared) vs 0.993 (worker-local) — 1.49x, near the
+        # theoretical 1.58x for interleaved equal gradients — because
+        # the PS velocity integrates all 10 pushes while each local one
+        # sees only 5 (tests/integration/test_multiprocess.py::
+        # test_shared_optimizer_state_on_ps).
         # compressor/aux state. These leaves are *per-replica* (e.g. each
         # device's error-feedback residual differs), so they carry an
         # explicit leading replica dimension sharded over the data axis.
@@ -489,12 +506,20 @@ class Session:
             feed_vals.append(v)
             split_flags.append(self._plan.feed_splittable(v, ph))
 
-        key = (tuple(id(f) for f in norm),
+        # PS-resident optimizer: also fetch the synced gradients of
+        # shared vars so they can be pushed raw (BSTEP applies the step
+        # service-side with shared slots)
+        shared_spec, extra_fetches = ([], [])
+        if self._loose and self._shared_opt_vars:
+            shared_spec, extra_fetches = self._shared_push_spec(norm)
+        all_fetches = norm + extra_fetches
+
+        key = (tuple(id(f) for f in all_fetches),
                tuple((id(p), v.shape, str(v.dtype), s)
                      for p, v, s in zip(feed_nodes, feed_vals, split_flags)))
         first_compile = key not in self._cache
         if first_compile:
-            self._cache[key] = self._build_step(norm, feed_nodes,
+            self._cache[key] = self._build_step(all_fetches, feed_nodes,
                                                 split_flags)
         fn = self._cache[key]
 
@@ -549,7 +574,12 @@ class Session:
         if is_train:
             self._step_count += 1
             if self._loose:
-                self._push_ps_deltas(pulled)
+                shared_push = {}
+                for name, idx, lr, mom in shared_spec:
+                    g = self._local_stack(outs[idx])[0]
+                    shared_push[name] = (np.asarray(g, np.float32),
+                                         lr, mom)
+                self._push_ps_deltas(pulled, shared_push)
                 self._coord.publish_step(self._worker_name,
                                          self._step_count,
                                          prefix=self._key('step/'))
@@ -601,24 +631,68 @@ class Session:
         self._ps_bytes += self._wire_nbytes(n_elems)
         return pulled
 
-    def _push_ps_deltas(self, pulled):
-        """Push ``new - pulled`` per variable: the binary BADD is
-        commutative, so concurrent workers' updates accumulate exactly
-        like the reference's apply-per-push accumulators. Endpoint
-        groups push in parallel."""
+    def _shared_push_spec(self, norm):
+        """Plan the PS-side optimizer pushes for the fetched train ops:
+        returns ``[(var_name, fetch_idx, lr, momentum)]`` plus the extra
+        (synced) gradient nodes to fetch. Optimizers without scalar
+        ``ps_step_params`` (non-SGD-family) fall back to worker-local
+        slots with a one-time note."""
+        spec = []
+        extra = []
+        node_pos = {id(f): i for i, f in enumerate(norm)}
+        for f in norm:
+            if not isinstance(f, fe.ApplyGradients):
+                continue
+            params = getattr(f.optimizer, 'ps_step_params', None)
+            for gnode, var in f.grads_and_vars:
+                if var.name not in self._shared_opt_vars:
+                    continue
+                if params is None:
+                    if var.name not in self._shared_warned:
+                        self._shared_warned.add(var.name)
+                        logging.warning(
+                            'shared_optimizer requested for %s but '
+                            'optimizer %s has no PS-side step (SGD '
+                            'family only); its slots stay worker-local',
+                            var.name, f.optimizer.name)
+                    continue
+                idx = node_pos.get(id(gnode))
+                if idx is None:
+                    idx = len(norm) + len(extra)
+                    node_pos[id(gnode)] = idx
+                    extra.append(gnode)
+                spec.append((var.name, idx, params['lr'],
+                             params['momentum']))
+        return spec, extra
+
+    def _push_ps_deltas(self, pulled, shared_push=None):
+        """Push per-variable updates. Default: ``new - pulled`` deltas —
+        the binary BADD is commutative, so concurrent workers' updates
+        accumulate exactly like the reference's apply-per-push
+        accumulators. Vars in ``shared_push`` instead ship their raw
+        gradient; the service applies the optimizer step with
+        PS-resident shared slots (BSTEP). Endpoint groups push in
+        parallel."""
         import time as _time
         t0 = _time.perf_counter()
+        shared_push = shared_push or {}
         afters = {name: np.asarray(self._local_value(name),
                                    dtype=np.float32)
-                  for name in pulled}
+                  for name in pulled if name not in shared_push}
 
         def push(client, name):
-            delta = afters[name] - np.asarray(pulled[name],
-                                              dtype=np.float32)
-            client.vadd(self._key('var/%s' % name), delta)
+            if name in shared_push:
+                g, lr, mom = shared_push[name]
+                client.vstep(self._key('var/%s' % name), g, lr, mom)
+            else:
+                delta = afters[name] - np.asarray(pulled[name],
+                                                  dtype=np.float32)
+                client.vadd(self._key('var/%s' % name), delta)
 
         self._ps_transfer(list(pulled), push)
-        n_elems = sum(a.size for a in afters.values())
+        self._shared_pushes += sum(1 for n in pulled if n in shared_push)
+        n_elems = sum(a.size for a in afters.values()) + \
+            sum(g.size for g, _, _ in shared_push.values())
         # post-update assign (proxy_variable.py:163-190): refresh the
         # proxy from the PS after the push, off the pre-step path
         refreshed = self._ps_transfer(

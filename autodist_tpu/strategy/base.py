@@ -39,6 +39,13 @@ class PSSynchronizer:
     local_replication: bool = False
     sync: bool = True
     staleness: int = 0
+    # loose mode: run the optimizer step ON the PS with service-resident
+    # slot state shared by all workers (the reference re-creates the
+    # optimizer over PS-resident variables, kernel/partitioner.py:570-573,
+    # and places the update op on the PS, ps_synchronizer.py:175-176).
+    # Supported for the SGD family (plain/momentum); other optimizers
+    # fall back to worker-local slots with a logged note.
+    shared_optimizer: bool = False
     kind: str = 'PS'
 
 

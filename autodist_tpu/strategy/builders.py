@@ -47,10 +47,12 @@ from autodist_tpu.kernels.partitioner import (                   # noqa: E402
 class PS(StrategyBuilder):
     """All variables on a single parameter server (the first CPU device)."""
 
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 shared_optimizer=False):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._shared_optimizer = shared_optimizer
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -63,17 +65,20 @@ class PS(StrategyBuilder):
                     reduction_destination=reduction_device,
                     local_replication=self._local_proxy_variable,
                     sync=self._sync,
-                    staleness=self._staleness)))
+                    staleness=self._staleness,
+                    shared_optimizer=self._shared_optimizer)))
         return s
 
 
 class PSLoadBalancing(StrategyBuilder):
     """Greedy byte-size bin-packing of variables onto all PS devices."""
 
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 shared_optimizer=False):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._shared_optimizer = shared_optimizer
         self.loads = {}
 
     def build(self, graph_item, resource_spec):
@@ -93,16 +98,19 @@ class PSLoadBalancing(StrategyBuilder):
                 reduction_destination=min_ps,
                 local_replication=self._local_proxy_variable,
                 sync=self._sync,
-                staleness=self._staleness))
+                staleness=self._staleness,
+                shared_optimizer=self._shared_optimizer))
 
 
 class PartitionedPS(StrategyBuilder):
     """Axis-0 partitioning onto load-balanced PSes."""
 
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 shared_optimizer=False):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._shared_optimizer = shared_optimizer
         self.loads = {}
 
     def build(self, graph_item, resource_spec):
@@ -134,7 +142,8 @@ class PartitionedPS(StrategyBuilder):
             return PSSynchronizer(
                 reduction_destination=dest,
                 local_replication=self._local_proxy_variable,
-                sync=self._sync, staleness=self._staleness)
+                sync=self._sync, staleness=self._staleness,
+                shared_optimizer=self._shared_optimizer)
 
         if num_shards == 1:
             return StrategyNode(var_name=var.name,

@@ -242,6 +242,55 @@ def test_async_ps_never_blocks(tmp_path):
         assert abs(r['b']) > 1e-4
 
 
+SHARED_OPT_BODY = textwrap.dedent("""
+    autodist = ad.AutoDist(
+        resource_info=RESOURCE_INFO,
+        strategy_builder=ad.strategy.PS(staleness=1, %(extra_kwargs)s))
+    inputs, outputs = make_data(123)     # same data on both roles
+    with autodist.scope():
+        x = ad.placeholder(shape=[None], dtype=np.float32, name='x')
+        y = ad.placeholder(shape=[None], dtype=np.float32, name='y')
+        W = ad.Variable(5.0, name='W')
+        b = ad.Variable(0.0, name='b')
+        loss = ad.ops.reduce_mean(ad.ops.square(W * x + b - y))
+        train_op = ad.optimizers.Momentum(0.01, momentum=0.9) \\
+            .minimize(loss, [W, b])
+        sess = autodist.create_distributed_session()
+        for _ in range(5):
+            sess.run(train_op, {x: inputs, y: outputs})
+        autodist._coord.barrier('test/trained', 2, timeout_s=120.0)
+        b_final = float(np.ravel(sess.get_variable_value('b'))[0])
+    print('RESULT ' + json.dumps(
+        {'role': ROLE, 'b': b_final,
+         'shared_pushes': sess._shared_pushes}), flush=True)
+    autodist._coord.barrier('test/done', 2, timeout_s=120.0)
+""")
+
+
+@pytest.mark.integration
+def test_shared_optimizer_state_on_ps(tmp_path):
+    """shared_optimizer=True runs the momentum step ON the PS with a
+    service-resident velocity shared by both workers (reference
+    PS-resident optimizer, kernel/partitioner.py:570-573). The shared
+    velocity integrates all 10 pushes (2 workers x 5 steps), so |b|
+    travels measurably further than with worker-local velocities that
+    each see only 5 pushes (theoretical ratio for interleaved equal
+    gradients: ~1.58)."""
+    shared = launch_pair(tmp_path, SHARED_OPT_BODY % {
+        'extra_kwargs': 'shared_optimizer=True'}, timeout=420)
+    local = launch_pair(tmp_path, SHARED_OPT_BODY % {
+        'extra_kwargs': 'shared_optimizer=False'}, timeout=420)
+    b_shared = next(r['b'] for r in shared if r['role'] == 'chief')
+    b_local = next(r['b'] for r in local if r['role'] == 'chief')
+    for r in shared:
+        # every step pushed both vars through BSTEP
+        assert r['shared_pushes'] == 10, r
+    for r in local:
+        assert r['shared_pushes'] == 0, r
+    assert abs(b_shared) > 1e-3 and abs(b_local) > 1e-3
+    assert abs(b_shared) > 1.15 * abs(b_local), (b_shared, b_local)
+
+
 @pytest.mark.integration
 def test_loose_mode_carries_100mb_model_multi_endpoint(tmp_path):
     """The binary PS data plane carries a real (≥100 MB) model, spread
