@@ -333,6 +333,9 @@ class Trainer:
                     # state (BN EMA) keeps the LAST chunk's update: each
                     # chunk computes its EMA from the pre-step state, so
                     # the running stats advance once per optimizer step
+                    # (semantics + tf.layers delta documented in
+                    # docs/usage/parallelism.md "Gradient accumulation
+                    # and BatchNorm statistics")
                     return (acc_loss + loss_c,
                             jax.tree.map(jnp.add, acc_grads, grads_c),
                             upd_c), None

@@ -364,6 +364,7 @@ class AutoDist:
         vars_before = set(graph.variables)
         pairs_before = dict(graph.grad_target_pairs)
         opts_before = len(graph.optimizers)
+        savers_before = len(graph.savers)
         ph_index = {}
         args_ph, kwargs_ph = [], {}
         for i, a in enumerate(args):
@@ -388,6 +389,9 @@ class AutoDist:
                 del graph.variables[name]
             graph.grad_target_pairs = pairs_before
             del graph.optimizers[opts_before:]
+            # a Saver constructed inside a failed trace references
+            # rolled-back variables — drop it with the trace
+            del graph.savers[savers_before:]
 
         try:
             with graph:

@@ -238,11 +238,15 @@ class CheckpointManager:
         then re-apply retention — the drained save was invisible to the
         retention pass that ran when it started."""
         if self._async_ckptr is not None:
-            self._async_ckptr.wait_until_finished()
             sidecar = getattr(self, '_pending_sidecar', None)
+            try:
+                self._async_ckptr.wait_until_finished()
+            finally:
+                # a failed flush must not leave stale pending-sidecar
+                # state for a later drain to misattribute
+                self._pending_sidecar = None
             if sidecar is not None:
                 path, step = sidecar
-                self._pending_sidecar = None
                 if os.path.exists(path):   # flush finalized the dir
                     with open(path + '.step', 'w') as f:
                         json.dump({'step': step}, f)

@@ -142,24 +142,48 @@ class Session:
             coord_addr = getattr(self._coord, 'address', None)
 
             def beat_loop():
-                # own client: CoordClient sockets are not thread-safe
+                # own client: CoordClient sockets are not thread-safe.
+                # Connection failures are retried forever: a long XLA
+                # compile or data stall on OUR side must not permanently
+                # silence the beats and get us declared dead by peers.
                 from autodist_tpu.runtime.coord_client import \
                     connect_with_retry
+                client = None
+                warned = False
                 try:
-                    client = connect_with_retry(coord_addr)
-                except Exception:   # noqa: BLE001 - liveness is advisory
-                    logging.warning('heartbeat thread could not reach '
-                                    'the coord service at %s; liveness '
-                                    'falls back to per-run beats',
-                                    coord_addr)
-                    return
-                try:
-                    while not stop.wait(interval):
-                        client.heartbeat(me)
-                except OSError:
-                    pass
+                    while not stop.is_set():
+                        if client is None:
+                            try:
+                                client = connect_with_retry(
+                                    coord_addr, deadline_s=interval)
+                            except Exception:  # noqa: BLE001 - advisory
+                                if not warned:
+                                    warned = True
+                                    logging.warning(
+                                        'heartbeat thread cannot reach '
+                                        'the coord service at %s yet; '
+                                        'retrying every %.0fs',
+                                        coord_addr, interval)
+                                if stop.wait(interval):
+                                    break
+                                continue
+                        try:
+                            client.heartbeat(me)
+                        except OSError:
+                            try:
+                                client.close()
+                            except OSError:
+                                pass
+                            client = None
+                            continue
+                        if stop.wait(interval):
+                            break
                 finally:
-                    client.close()
+                    if client is not None:
+                        try:
+                            client.close()
+                        except OSError:
+                            pass
 
             threading.Thread(target=beat_loop, daemon=True,
                              name='autodist-heartbeat').start()
@@ -232,6 +256,11 @@ class Session:
         self._coord.heartbeat(self._key(self._worker_name))
         dead = self._coord.dead_workers(self._hb_peers, timeout,
                                         self._hb_seen)
+        if dead:
+            # a peer that closed its session cleanly stops beating but
+            # is NOT a crash: it published a done key (Session.close)
+            dead = [w for w in dead
+                    if self._coord.get('done/%s' % w) is None]
         if dead:
             raise RuntimeError(
                 'worker(s) %s missed heartbeats for > %.0fs while this '
@@ -806,6 +835,18 @@ class Session:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):
+        if not self._closed and self._loose and self._coord is not None:
+            # clean shutdown is not a crash: publish a done marker so
+            # peers exclude us from dead-worker checks, and advance our
+            # step counter past any reachable gate bound so a peer
+            # blocked on the staleness window is released
+            try:
+                self._coord.set(
+                    'done/%s' % self._key(self._worker_name), '1')
+                self._coord.publish_step(self._worker_name, 1 << 30,
+                                         prefix=self._key('step/'))
+            except Exception:  # noqa: BLE001 - service may be gone
+                pass
         self._closed = True
         if getattr(self, '_hb_stop', None) is not None:
             self._hb_stop.set()

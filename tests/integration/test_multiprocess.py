@@ -357,6 +357,50 @@ def test_loose_mode_carries_100mb_model_multi_endpoint(tmp_path):
 
 
 @pytest.mark.integration
+def test_clean_peer_shutdown_is_not_a_crash(tmp_path):
+    """A peer that finishes its run and closes its session cleanly must
+    not be reported as dead: Session.close publishes a done marker and
+    advances its step counter past any gate bound, so a chief still
+    training runs to completion instead of raising 'missed heartbeats'
+    (ADVICE r2)."""
+    body = textwrap.dedent("""
+        autodist = ad.AutoDist(
+            resource_info=RESOURCE_INFO,
+            strategy_builder=ad.strategy.PS(staleness=2))
+        inputs, outputs = make_data(123 if ROLE == 'chief' else 456)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None], dtype=np.float32, name='x')
+            y = ad.placeholder(shape=[None], dtype=np.float32, name='y')
+            W = ad.Variable(5.0, name='W')
+            b = ad.Variable(0.0, name='b')
+            loss = ad.ops.reduce_mean(ad.ops.square(W * x + b - y))
+            train_op = ad.optimizers.SGD(0.01).minimize(loss, [W, b])
+            sess = autodist.create_distributed_session()
+            if ROLE == 'worker':
+                for _ in range(2):
+                    sess.run(train_op, {x: inputs, y: outputs})
+                sess.close()   # clean finish: done marker published
+                print('RESULT ' + json.dumps({'role': ROLE}), flush=True)
+                sys.exit(0)
+            steps, failed = 0, ''
+            try:
+                for _ in range(10):
+                    sess.run(train_op, {x: inputs, y: outputs})
+                    steps += 1
+            except RuntimeError as e:
+                failed = str(e)
+            print('RESULT ' + json.dumps(
+                {'role': ROLE, 'steps': steps, 'failed': failed}),
+                flush=True)
+    """)
+    results = launch_pair(tmp_path, body, timeout=300,
+                          extra_env={'AUTODIST_HEARTBEAT_TIMEOUT': '4'})
+    chief = results[0]
+    assert chief['failed'] == '', chief
+    assert chief['steps'] == 10, chief
+
+
+@pytest.mark.integration
 def test_dead_worker_fails_fast_not_hangs(tmp_path):
     """Failure detection: the worker crashes mid-run; the chief, blocked
     on the staleness gate, must surface a dead-peer error within the
