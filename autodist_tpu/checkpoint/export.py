@@ -1,0 +1,126 @@
+"""Servable export on ``jax.export`` / StableHLO.
+
+Reference parity: ``SavedModelBuilder`` writes a bundle another process
+can load and serve (``autodist/checkpoint/saved_model_builder.py:24-64``,
+proven by ``tests/checkpoint/test_saved_model.py:26-29`` reloading it in
+a fresh session). The TPU-native bundle is:
+
+    export_dir/
+      saved_model.json            # format, tags, per-signature metadata
+      module.<signature>.shlo     # jax.export serialized artifact
+      variables/                  # logical-layout params (manifest + .npy)
+
+The ``.shlo`` blob is a self-describing, versioned StableHLO artifact:
+serving needs only ``jax`` + ``numpy`` — no framework import — via
+
+    module = jax.export.deserialize(open(blob, 'rb').read())
+    outs = module.call(params_dict, *inputs)
+
+where ``params_dict`` is the flat ``{name: array}`` dict from
+``variables/`` (plain dicts are pytrees with deterministic sorted-key
+order, so the call convention is stable). Input batch dims declared
+polymorphic (``None`` in a placeholder shape) are exported as symbolic
+dimensions, so the served module accepts any batch size.
+"""
+import json
+import os
+
+import numpy as np
+
+import jax
+from jax import export as jax_export
+
+from autodist_tpu.checkpoint.saver import load_pytree, save_pytree
+from autodist_tpu.utils import logging
+
+_FORMAT = 'autodist_tpu.saved_model.v1'
+
+
+def _input_spec(shape, dtype, scope, sym_names):
+    """ShapeDtypeStruct for one input; ``None`` dims become symbolic
+    (shared scope, so one symbol name = one dimension variable)."""
+    dims = []
+    for i, d in enumerate(tuple(shape or ())):
+        if d is None:
+            # leading dim shares the batch symbol; later unknown dims
+            # each get their own
+            name = 'b' if i == 0 else 'd%d' % len(sym_names)
+            sym_names.add(name)
+            dims.append(jax_export.symbolic_shape(name, scope=scope)[0])
+        else:
+            dims.append(int(d))
+    return jax.ShapeDtypeStruct(tuple(dims), np.dtype(dtype))
+
+
+def export_servable(fn, params, input_shapes, path,
+                    signature='serving_default', tags=('serve',),
+                    platforms=('cpu', 'tpu'), input_names=None):
+    """Export ``fn(params, *inputs) -> list of outputs`` as a servable
+    bundle.
+
+    Args:
+        fn: pure function of (params pytree, *input arrays).
+        params: pytree of host/device arrays (saved to ``variables/``).
+        input_shapes: list of (shape, dtype); ``None`` dims symbolic.
+        path: export directory (created; existing signatures preserved).
+        signature: name of this entrypoint.
+        platforms: lowering targets baked into the artifact.
+        input_names: optional names recorded in the metadata.
+    """
+    os.makedirs(path, exist_ok=True)
+    scope = jax_export.SymbolicScope()
+    sym_names = set()
+    specs = [_input_spec(s, d, scope, sym_names) for s, d in input_shapes]
+    host_params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
+                               params)
+    param_specs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), host_params)
+    exported = jax_export.export(
+        jax.jit(fn), platforms=list(platforms))(param_specs, *specs)
+    module_file = 'module.%s.shlo' % signature
+    with open(os.path.join(path, module_file), 'wb') as f:
+        f.write(exported.serialize())
+    save_pytree(os.path.join(path, 'variables'), host_params)
+
+    meta_path = os.path.join(path, 'saved_model.json')
+    meta = {'format': _FORMAT, 'tags': list(tags), 'signatures': {}}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            old = json.load(f)
+        if old.get('format') == _FORMAT:
+            meta['signatures'] = old.get('signatures', {})
+    meta['signatures'][signature] = {
+        'module_file': module_file,
+        'platforms': list(platforms),
+        'inputs': [{'name': (input_names[i] if input_names else
+                             'input_%d' % i),
+                    'shape': [None if not isinstance(d, int) else d
+                              for d in spec.shape],
+                    'dtype': str(spec.dtype)}
+                   for i, spec in enumerate(specs)],
+        'call_convention':
+            'module.call(flat_params_dict, *inputs) -> flat outputs',
+    }
+    with open(meta_path, 'w') as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    logging.info('Exported servable signature %r to %s', signature, path)
+    return path
+
+
+def load_servable(path, signature='serving_default'):
+    """Load a servable bundle; returns ``serve(*inputs)`` with the
+    saved params bound. (Convenience wrapper — a fresh process can do
+    the same with only jax + numpy, see the module docstring.)"""
+    with open(os.path.join(path, 'saved_model.json')) as f:
+        meta = json.load(f)
+    if meta.get('format') != _FORMAT:
+        raise ValueError('%s is not an %s bundle' % (path, _FORMAT))
+    sig = meta['signatures'][signature]
+    with open(os.path.join(path, sig['module_file']), 'rb') as f:
+        module = jax_export.deserialize(f.read())
+    params, _ = load_pytree(os.path.join(path, 'variables'))
+
+    def serve(*inputs):
+        return module.call(params, *inputs)
+
+    return serve
