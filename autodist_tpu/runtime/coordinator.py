@@ -63,23 +63,40 @@ class Coordinator:
                 env[flag.name] = raw
         return env
 
-    def _copy_strategy(self, address, ssh_config):
-        """Ship the serialized strategy file to a worker host (reference
-        coordinator.py:56-64 SFTP copy)."""
-        src = self._strategy.path
-        dest = '%s:%s' % (address, src)
-        cmd = ['scp', '-o', 'StrictHostKeyChecking=no']
+    def _ssh_base(self, ssh_config, scp=False):
+        cmd = ['scp' if scp else 'ssh', '-o',
+               'StrictHostKeyChecking=no']
         if ssh_config and ssh_config.key_file:
             cmd += ['-i', ssh_config.key_file]
         if ssh_config and ssh_config.port != 22:
-            cmd += ['-P', str(ssh_config.port)]
-        if ssh_config and ssh_config.username:
-            dest = '%s@%s' % (ssh_config.username, dest)
-        cmd += [src, dest]
+            cmd += ['-P' if scp else '-p', str(ssh_config.port)]
+        return cmd
+
+    @staticmethod
+    def _target(address, ssh_config):
+        return address if not (ssh_config and ssh_config.username) \
+            else '%s@%s' % (ssh_config.username, address)
+
+    def _copy_strategy(self, address, ssh_config):
+        """Ship the serialized strategy file to a worker host (reference
+        coordinator.py:56-64 SFTP copy).
+
+        Copies to a temp name then renames remotely: atomic placement,
+        and safe when chief and worker share a filesystem (scp'ing a
+        file onto its own path truncates it before reading)."""
+        src = self._strategy.path
+        tmp = '%s.ship.%d' % (src, os.getpid())
+        target = self._target(address, ssh_config)
+        scp_cmd = self._ssh_base(ssh_config, scp=True) + \
+            [src, '%s:%s' % (target, tmp)]
+        mv_cmd = self._ssh_base(ssh_config) + \
+            [target, 'mv -f %s %s' % (shlex.quote(tmp), shlex.quote(src))]
         if ENV.AUTODIST_DEBUG_REMOTE.val:
-            logging.info('[debug-remote] %s', ' '.join(cmd))
+            logging.info('[debug-remote] %s', ' '.join(scp_cmd))
+            logging.info('[debug-remote] %s', ' '.join(mv_cmd))
             return
-        subprocess.run(cmd, check=True)
+        subprocess.run(scp_cmd, check=True)
+        subprocess.run(mv_cmd, check=True)
 
     def launch_clients(self):
         """Re-run ``sys.argv`` on every non-chief replica host."""
@@ -98,14 +115,8 @@ class Coordinator:
                 venv = '. %s/bin/activate && ' % ssh_config.python_venv
             remote_cmd = 'cd %s && %s%s %s' % (
                 shlex.quote(os.getcwd()), venv, env_str, script)
-            cmd = ['ssh', '-o', 'StrictHostKeyChecking=no']
-            if ssh_config and ssh_config.key_file:
-                cmd += ['-i', ssh_config.key_file]
-            if ssh_config and ssh_config.port != 22:
-                cmd += ['-p', str(ssh_config.port)]
-            target = address if not (ssh_config and ssh_config.username) \
-                else '%s@%s' % (ssh_config.username, address)
-            cmd += [target, remote_cmd]
+            cmd = self._ssh_base(ssh_config) + \
+                [self._target(address, ssh_config), remote_cmd]
             if ENV.AUTODIST_DEBUG_REMOTE.val:
                 logging.info('[debug-remote] %s', ' '.join(cmd))
                 continue
