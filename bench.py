@@ -186,11 +186,78 @@ def bench_longctx(steps):
     return batch_size * seq * steps / dt
 
 
+def bench_scaling(steps=5):
+    """Multi-device scaling: the same workload at dp=1 and dp=n on this
+    process's device set (virtual CPU mesh or a real pod slice).
+
+    Reported metrics:
+    - per-chip tokens/s at each dp, and ``parallel_efficiency`` =
+      per-chip(dp=n) / per-chip(dp=1) — the real scaling number on
+      hardware where devices are independent chips;
+    - ``serialized_weak_scaling_efficiency`` = n*t(dp=1)/t(dp=n) — on a
+      virtual CPU mesh all devices share the host cores, so compute
+      serializes and per-chip throughput trivially divides by n; this
+      ratio instead isolates the OVERHEAD the dp lowering adds
+      (collectives, partitioning) over perfectly serialized compute
+      (ideal = 1.0). On a pod, read parallel_efficiency; on the CPU
+      mesh, read this.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from autodist_tpu.parallel.axes import ParallelSpec
+
+    n = max(1, len(jax.devices()))
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    if on_tpu:
+        cfg = TransformerConfig.gpt_small(dtype=jnp.bfloat16, remat=True)
+        per_dev_batch, seq = 64, 512
+    else:
+        cfg = TransformerConfig.tiny(dtype=jnp.float32)
+        per_dev_batch, seq = 4, 64
+    rng = np.random.RandomState(0)
+    times = {}
+    for dp in sorted({1, n}):
+        batch_size = per_dev_batch * dp
+        batch = {'tokens': rng.randint(0, cfg.vocab, (batch_size, seq),
+                                       dtype=np.int32),
+                 'targets': rng.randint(0, cfg.vocab, (batch_size, seq),
+                                        dtype=np.int32)}
+        dt, _ = run_workload(TransformerLM(cfg), batch, steps,
+                             spec=ParallelSpec(dp=dp))
+        times[dp] = (dt, batch_size * seq * steps / dt / dp)
+    t1, tps1 = times[1]
+    tn, tpsn = times[n]
+    return {
+        'metric': 'dp_scaling_tokens_per_sec_per_chip',
+        'value': round(tpsn, 1),
+        'unit': 'tokens/s/chip@dp=%d' % n,
+        'vs_baseline': 0.0,
+        'extra': {
+            'devices': n,
+            'platform': jax.devices()[0].platform,
+            'tokens_per_sec_per_chip_dp1': round(tps1, 1),
+            'parallel_efficiency': round(tpsn / tps1, 3) if n > 1 else 1.0,
+            'serialized_weak_scaling_efficiency':
+                round(n * t1 / tn, 3) if n > 1 else 1.0,
+            'step_time_s': {'dp1': round(t1 / steps, 4),
+                            'dp%d' % n: round(tn / steps, 4)},
+        },
+    }
+
+
 def main():
+    import sys
+
     import jax
 
     from autodist_tpu.utils.jax_env import apply_jax_env_overrides
     apply_jax_env_overrides()
+    if '--scaling' in sys.argv:
+        print(json.dumps(bench_scaling()))
+        return
     n = max(1, len(jax.devices()))
     dev = jax.devices()[0]
     on_tpu = dev.platform == 'tpu'

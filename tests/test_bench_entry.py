@@ -29,6 +29,24 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert rec['value'] > 0
 
 
+def test_bench_scaling_mode_reports_efficiency():
+    """`bench.py --scaling` measures dp=1 vs dp=8 on the virtual mesh
+    and reports both efficiency views (parallel + serialized-weak)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench_mod', os.path.join(REPO, 'bench.py'))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    rec = m.bench_scaling(steps=2)
+    assert rec['extra']['devices'] == 8
+    assert rec['value'] > 0
+    assert rec['extra']['tokens_per_sec_per_chip_dp1'] > 0
+    assert 0 < rec['extra']['parallel_efficiency'] <= 1.5
+    # on the shared-core CPU mesh the dp lowering must not add gross
+    # overhead over perfectly serialized compute
+    assert rec['extra']['serialized_weak_scaling_efficiency'] > 0.5
+
+
 def test_graft_entry_forward():
     import jax
 
