@@ -77,19 +77,20 @@ class MultiHeadAttention(Module):
     # -- nested-manual flash under dp/tp GSPMD -----------------------------
     def _tp_manual_shape(self, shape):
         """Per-shard [b, h, s, d] when the nested-manual flash path
-        applies, else None. Conditions: no manual region already active,
-        a mesh whose only size>1 axes are data and the heads axis, head
-        and batch dims divisible, and the per-shard shape past the
-        kernel crossover."""
+        applies, else None. Conditions: no manual region already active
+        (ring/Ulysses and the pipeline own their shard_maps), a mesh
+        with a live data and/or heads axis, batch/head dims divisible,
+        and the per-shard shape past the kernel crossover. Mesh axes
+        OTHER than data/heads (pipe, seq, expert) may be live: attention
+        inputs are not sharded over them, so the nested region simply
+        leaves them untouched (round-2 fix — they used to drop long-seq
+        attention to the jnp path silently)."""
         if active_manual_axes():
             return None
         mesh = current_mesh()
         if mesh is None:
             return None
         heads_axis = live_mesh_axis('heads')
-        for name, size in mesh.shape.items():
-            if size > 1 and name != AXIS_DATA and name != heads_axis:
-                return None
         dp = mesh.shape.get(AXIS_DATA, 1)
         tp = mesh.shape[heads_axis] if heads_axis else 1
         if dp * tp <= 1 or shape[0] % dp or shape[1] % tp:

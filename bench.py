@@ -164,10 +164,12 @@ def bench_resnet101(n, steps, on_tpu):
 def bench_longctx(steps):
     """Long-context training point: gpt_small at seq 4096 through the
     Pallas flash-attention path (3.4x over XLA attention at this length
-    on v5e). Pinned to ONE device (dp=1) because the flash kernel only
-    dispatches for device-local execution — on a pod, a dp>1 GSPMD mesh
-    would silently fall back to the XLA path and mislabel this metric.
-    TPU-only; the CPU smoke skips it."""
+    on v5e). Pinned to ONE device (dp=1) so the metric is a pure
+    single-chip number: on a pod, dp>1 would still hit the kernel (the
+    module hops into a nested-manual region over the data/heads axes,
+    models/attention.py:_tp_manual_flash) but the figure would then mix
+    collective overheads into a per-chip kernel benchmark. TPU-only;
+    the CPU smoke skips it."""
     import jax.numpy as jnp
 
     from autodist_tpu.models.transformer import (TransformerConfig,
