@@ -240,6 +240,8 @@ class Trainer:
         mesh = self.mesh
         manual = self.manual_axes
         options = {'microbatches': self.spec.microbatches,
+                   'pp_schedule': getattr(self.spec, 'pp_schedule',
+                                          'gpipe'),
                    'sp_mode': getattr(self.spec, 'sp_mode', 'ring')}
 
         def per_token(params, batch):

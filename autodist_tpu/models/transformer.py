@@ -166,11 +166,9 @@ class TransformerLM(Module):
             return self.embed.attend(params['embed'], x)
         return self.lm_head.apply(params['lm_head'], x)
 
-    def hidden_with_aux(self, params, tokens):
-        """Final hidden states (post ln_f) and the MoE aux loss —
-        everything except the lm-head, so losses can chunk the head."""
-        cfg = self.cfg
-        b, s = tokens.shape
+    def _embedded(self, params, tokens):
+        """Embedding + positions (the pipeline prologue)."""
+        _, s = tokens.shape
         x = self.embed.apply(params['embed'], tokens)
         # global positions: offset by the manual seq-shard index when the
         # sequence axis runs inside shard_map (ring attention mode)
@@ -179,19 +177,30 @@ class TransformerLM(Module):
         if seq_axis is not None:
             pos = pos + jax.lax.axis_index(seq_axis) * s
         x = x + self.pos_embed.apply(params['pos_embed'], pos)[None]
-        x = constrain(x, ('batch', 'seq', 'embed'))
+        return constrain(x, ('batch', 'seq', 'embed'))
 
+    def _block_fn(self):
+        """Single-block apply with the remat policy applied."""
+        cfg = self.cfg
         block_fn = self.block.apply
         if isinstance(cfg.remat, str) and cfg.remat != 'save_attn':
             raise ValueError('unknown remat mode %r (expected False, '
                              'True, or \'save_attn\')' % (cfg.remat,))
         if cfg.remat == 'save_attn':
-            block_fn = jax.checkpoint(
+            return jax.checkpoint(
                 block_fn,
                 policy=jax.checkpoint_policies.save_only_these_names(
                     'attn_out'))
-        elif cfg.remat:
-            block_fn = jax.checkpoint(block_fn)
+        if cfg.remat:
+            return jax.checkpoint(block_fn)
+        return block_fn
+
+    def hidden_with_aux(self, params, tokens):
+        """Final hidden states (post ln_f) and the MoE aux loss —
+        everything except the lm-head, so losses can chunk the head."""
+        cfg = self.cfg
+        x = self._embedded(params, tokens)
+        block_fn = self._block_fn()
         aux_total = jnp.zeros((), jnp.float32)
         pipe_axis = manual_axis(AXIS_PIPELINE)
         if pipe_axis is not None:
@@ -199,9 +208,11 @@ class TransformerLM(Module):
                 raise ValueError(
                     'pipeline parallelism requires scan_layers=True '
                     '(blocks must be stage-stacked to shard over pipe)')
-            from autodist_tpu.parallel.pipeline import gpipe
-            x, aux_pipe = gpipe(block_fn, params['blocks'], x, pipe_axis,
-                                ctx_option('microbatches', 1))
+            from autodist_tpu.parallel.pipeline import gpipe, one_f_one_b
+            pipe_fn = one_f_one_b \
+                if ctx_option('pp_schedule', 'gpipe') == '1f1b' else gpipe
+            x, aux_pipe = pipe_fn(block_fn, params['blocks'], x, pipe_axis,
+                                  ctx_option('microbatches', 1))
             aux_total = aux_total + aux_pipe
         elif cfg.scan_layers:
             def body(carry, layer_params):
@@ -233,6 +244,10 @@ class TransformerLM(Module):
         Under SP, MoE routing groups are the local seq shards (GShard
         grouping), so capacity/dropping is per-shard."""
         targets = batch['targets']
+        pipe_axis = manual_axis(AXIS_PIPELINE)
+        if pipe_axis is not None and \
+                ctx_option('pp_schedule', 'gpipe') == '1f1b':
+            return self._loss_1f1b(params, batch, pipe_axis)
         x, aux = self.hidden_with_aux(params, batch['tokens'])
         b, s = targets.shape
         n = self._ce_chunks(s, b * s)
@@ -252,6 +267,33 @@ class TransformerLM(Module):
         else:
             nll = self._chunk_nll(params, x, targets)
         return nll, aux
+
+    def _loss_1f1b(self, params, batch, pipe_axis):
+        """Pipelined loss with the head folded into the pipeline's last
+        stage (1F1B): targets stream alongside activations and the
+        pipeline emits per-token NLL ``[mb, seq]`` per microbatch, so
+        neither a full-batch ``[B, s, dim]`` activation stack nor a
+        full-batch ``[B, s, vocab]`` logits slab ever materializes.
+        ``loss_chunk`` is subsumed — each microbatch IS a head chunk."""
+        cfg = self.cfg
+        if not cfg.scan_layers:
+            raise ValueError(
+                'pipeline parallelism requires scan_layers=True '
+                '(blocks must be stage-stacked to shard over pipe)')
+        from autodist_tpu.parallel.pipeline import one_f_one_b
+        x = self._embedded(params, batch['tokens'])
+
+        # checkpointed like the chunked-CE scan: backward recomputes each
+        # microbatch's [mb, s, vocab] logits instead of saving one per
+        # schedule step (which would re-materialize the full-batch slab)
+        @jax.checkpoint
+        def tail(h, tgt):
+            h = self.ln_f.apply(params['ln_f'], h)
+            return self._chunk_nll(params, h, tgt)
+
+        return one_f_one_b(self._block_fn(), params['blocks'], x,
+                           pipe_axis, ctx_option('microbatches', 1),
+                           tail_fn=tail, extra=batch['targets'])
 
     def _chunk_nll(self, params, x, targets):
         logits = constrain(self._head_logits(params, x).astype(jnp.float32),

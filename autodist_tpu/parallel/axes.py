@@ -67,6 +67,10 @@ class ParallelSpec:
     zero: int = 1
     remat: str = 'none'
     microbatches: int = 1          # pipeline microbatches (pp>1)
+    # 'gpipe' | '1f1b': 1f1b keeps only each rank's microbatch share
+    # resident (+ per-microbatch remat); gpipe holds full input/output
+    # stacks on every rank but accepts ragged microbatch counts
+    pp_schedule: str = 'gpipe'
     sp_mode: str = 'ring'          # 'ring' | 'ulysses' (sp>1 attention)
     grad_accum: int = 1            # gradient-accumulation chunks
     rules: list = field(default_factory=lambda: [list(r)

@@ -111,3 +111,141 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
     # M valid microbatches; sum stages, average microbatches
     aux = lax.psum(aux_acc, axis_name) / M
     return out, aux
+
+
+def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
+                tail_fn=None, extra=None):
+    """1F1B-memory-profile schedule with per-rank microbatch residency.
+
+    Same fill/steady/drain timing as :func:`gpipe` (the forward bubble
+    is inherent), but the memory contract differs — full-batch
+    activations never live across the schedule:
+
+    - inputs: rank ``r`` owns microbatches ``r, r+pp, ...`` (``M/pp`` of
+      them) and puts each on the wire (a masked ``psum`` delivery to
+      stage 0) exactly when the schedule consumes it — instead of every
+      rank closing over the full ``[M, mb]`` input stack;
+    - ``tail_fn(h, extra_mb)``: applied after the last stage's blocks,
+      PER MICROBATCH — fold the head + loss in here so the pipeline
+      emits ``[mb, seq]`` per-token losses instead of ``[mb, seq, dim]``
+      activations (and per-microbatch logits instead of a full-batch
+      ``[B, seq, vocab]`` slab). ``extra`` ([B, ...], e.g. targets)
+      streams through the pipe alongside the activations;
+    - outputs: the last stage's (tail) result for microbatch ``j`` is
+      delivered to its owner ``j % pp`` the step it is produced; each
+      rank holds only its ``[M/pp, mb, ...]`` share, and the (small)
+      full result is reassembled once at region exit.
+
+    The fwd/bwd *interleave* itself is autodiff's reverse scan, not a
+    hand-written schedule; what is delivered (and asserted by
+    ``compiled.memory_analysis()`` in the tests) is the 1F1B working-set
+    property — live full-batch buffers are eliminated and per-step
+    residuals are microbatch-sized.
+
+    Requires ``M % pp == 0`` (round-robin residency); use ``gpipe`` for
+    ragged microbatch counts.
+    """
+    pp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = int(microbatches)
+    assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
+    mb = B // M
+
+    def local_stack(h):
+        def body(c, p):
+            h, aux = c
+            h, a = block_fn(p, h)
+            return (h, aux + a.astype(jnp.float32)), None
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                               stacked_params)
+        return h, aux
+
+    if pp == 1:
+        h, aux = local_stack(x)
+        if tail_fn is not None:
+            h = tail_fn(h, extra)
+        return h, aux
+    if M % pp:
+        raise ValueError(
+            "pp_schedule='1f1b' needs microbatches %% pp == 0 "
+            '(got M=%d, pp=%d); use gpipe for ragged counts' % (M, pp))
+
+    share = M // pp
+    own_idx = jnp.arange(share) * pp + rank   # round-robin residency
+
+    def to_mb(a):
+        return a.reshape(M, mb, *a.shape[1:])
+
+    xs = to_mb(x)
+    own_in = jnp.take(xs, own_idx, axis=0)
+    extra_s = None if extra is None else to_mb(extra)
+    own_extra = None if extra is None else jnp.take(extra_s, own_idx,
+                                                    axis=0)
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    zero_h = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    zero_e = None if extra is None else \
+        jnp.zeros((mb,) + extra.shape[1:], extra.dtype)
+
+    def tail(h, e):
+        return h if tail_fn is None else tail_fn(h, e)
+
+    out_shape = jax.eval_shape(tail, zero_h, zero_e)
+    zero_out = jnp.zeros(out_shape.shape, out_shape.dtype)
+
+    def deliver(mine, zero, cond_):
+        """Masked-psum delivery of one microbatch-sized tensor."""
+        return lax.psum(jnp.where(cond_, mine, zero), axis_name)
+
+    def step(carry, t):
+        state_h, state_e, own_out, aux_acc = carry
+        # input delivery: the owner of microbatch t puts it on the wire
+        owner = jnp.mod(t, pp)
+        slot = jnp.clip(t // pp, 0, share - 1)
+        emit = jnp.logical_and(rank == owner, t < M)
+        feed_h = deliver(lax.dynamic_index_in_dim(own_in, slot, 0,
+                                                  keepdims=False),
+                         zero_h, emit)
+        inp_h = jnp.where(rank == 0, feed_h, state_h)
+        if extra is None:
+            inp_e = None
+        else:
+            feed_e = deliver(lax.dynamic_index_in_dim(own_extra, slot, 0,
+                                                      keepdims=False),
+                             zero_e, emit)
+            inp_e = jnp.where(rank == 0, feed_e, state_e)
+        valid = jnp.logical_and(t >= rank, t < rank + M)
+        h, aux = lax.cond(
+            valid, local_stack,
+            lambda v: (v, jnp.zeros((), jnp.float32)), inp_h)
+        aux_acc = aux_acc + aux
+        # the last stage's per-microbatch tail (head/loss when folded);
+        # other ranks compute it on pipeline-register values and the
+        # result is masked out — the bubble idles either way, and the
+        # full-batch head this replaces also ran on every rank
+        out_val = tail(h, inp_e)
+        # output delivery: microbatch j leaves the last stage this step
+        j = t - (pp - 1)
+        done = deliver(out_val, zero_out,
+                       jnp.logical_and(rank == pp - 1, j >= 0))
+        take = jnp.logical_and(j >= 0, jnp.mod(j, pp) == rank)
+        slot_out = jnp.clip(j // pp, 0, share - 1)
+        prev = lax.dynamic_index_in_dim(own_out, slot_out, 0,
+                                        keepdims=False)
+        own_out = lax.dynamic_update_index_in_dim(
+            own_out, jnp.where(take, done, prev), slot_out, 0)
+        nxt_h = lax.ppermute(h, axis_name, fwd_perm)
+        nxt_e = None if extra is None else \
+            lax.ppermute(inp_e, axis_name, fwd_perm)
+        return (nxt_h, nxt_e, own_out, aux_acc), None
+
+    own_out = jnp.zeros((share,) + zero_out.shape, zero_out.dtype)
+    (_, _, own_out, aux_acc), _ = lax.scan(
+        step, (zero_h, zero_e, own_out, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + pp - 1))
+    # reassemble once, at exit: gathered[r, s] is microbatch s*pp + r
+    gathered = lax.all_gather(own_out, axis_name)  # [pp, share, mb, ...]
+    out = jnp.moveaxis(gathered, 0, 1).reshape(
+        (B,) + zero_out.shape[1:])
+    aux = lax.psum(aux_acc, axis_name) / M
+    return out, aux

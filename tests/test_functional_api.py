@@ -76,6 +76,60 @@ def test_pipeline_parallel_matches_dp(batch):
     assert np.allclose(pp, base, atol=2e-4), (pp, base)
 
 
+def test_pipeline_1f1b_matches_dp(batch):
+    """The 1F1B schedule (per-rank microbatch residency) is numerically
+    identical to DP, like GPipe."""
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=4)
+    model = TransformerLM(cfg)
+    base = run_losses(model, ParallelSpec(), batch)
+    f1b = run_losses(model, ParallelSpec(pp=2, tp=2, microbatches=4,
+                                         pp_schedule='1f1b'), batch)
+    assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
+
+
+def test_pipeline_1f1b_ragged_microbatches_rejected(batch):
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=4)
+    model = TransformerLM(cfg)
+    with pytest.raises(ValueError, match='1f1b'):
+        run_losses(model, ParallelSpec(pp=2, microbatches=1,
+                                       pp_schedule='1f1b'), batch,
+                   steps=1)
+
+
+def test_pipeline_1f1b_reduces_peak_memory():
+    """The point of 1F1B: folding the head/loss into the last stage
+    (per-microbatch, checkpointed) means no full-batch [B, s, vocab]
+    logits slab and no full-batch activation stacks live across the
+    schedule — the compiled step's temp memory must come in below
+    GPipe's. Vocab is sized so the logits slab dominates (measured:
+    ~334 MB gpipe vs ~291 MB 1f1b at these shapes on the CPU
+    accounting)."""
+    import dataclasses
+
+    import optax as _optax
+
+    from autodist_tpu.api import Trainer
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(dtype=jnp.float32, n_layers=4,
+                               max_len=128), vocab=4096)
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    big = {'tokens': rng.randint(0, 4096, (32, 128)),
+           'targets': rng.randint(0, 4096, (32, 128))}
+
+    def temp_bytes(schedule):
+        tr = Trainer(model, _optax.sgd(0.1),
+                     spec=ParallelSpec(pp=2, dp=1, microbatches=8,
+                                       pp_schedule=schedule))
+        state = tr.init(jax.random.PRNGKey(0))
+        compiled = tr.compile_step(state, big)
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    gpipe_bytes = temp_bytes('gpipe')
+    f1b_bytes = temp_bytes('1f1b')
+    assert f1b_bytes < 0.95 * gpipe_bytes, (f1b_bytes, gpipe_bytes)
+
+
 def test_moe_aux_loss_kept_under_pipelining(batch):
     """The MoE router balance loss survives GPipe: with microbatches=1
     the pipelined loss (incl. aux) matches the DP loss exactly; a
