@@ -220,15 +220,34 @@ def avg_pool(x, size=2, strides=None, padding='VALID'):
 
 
 # Control flow -------------------------------------------------------------
-def while_loop(cond_fn, body_fn, init):
+def while_loop(cond_fn, body_fn, init, max_iters=None):
     """Lifted ``lax.while_loop`` over symbolic carries.
 
     The condition/body are jax-level functions applied to traced values —
     the compiler-friendly replacement for the reference's TF v1 while_loop
     handling (case c4, control-flow contexts in replicator.py:92-103).
+
+    With ``max_iters`` (a static trip bound), the loop lowers to a
+    bounded ``lax.scan`` whose body is gated by ``cond_fn`` via
+    ``lax.cond`` — semantically identical for any loop that terminates
+    within the bound, and REVERSE-DIFFERENTIABLE, restoring the
+    reference's ability to train through ``tf.while_loop``
+    (cases/c4.py:24-34). Without it, the loop is a true
+    ``lax.while_loop``: unbounded, forward-only.
     """
+    if max_iters is None:
+        def fn(*vals):
+            return jax.lax.while_loop(cond_fn, body_fn, tuple(vals))
+        return fe.Op(fn, list(init))
+
     def fn(*vals):
-        return jax.lax.while_loop(cond_fn, body_fn, tuple(vals))
+        def step(carry, _):
+            keep_going = cond_fn(carry)
+            new = jax.lax.cond(keep_going, body_fn, lambda c: c, carry)
+            return new, None
+        out, _ = jax.lax.scan(step, tuple(vals), None,
+                              length=int(max_iters))
+        return out
     return fe.Op(fn, list(init))
 
 

@@ -83,25 +83,26 @@ def run_c4(autodist, epochs=3):
         b = ad.Variable(0.0, name='b')
 
         # reference c4.py:24-34: iterate sigmoid(W*state + b) 3 times
-        # under a loop, regress the fixed point onto y. JAX cannot
-        # reverse-differentiate while_loop, so the differentiable path
-        # uses fori_loop with static bounds (the compiler-friendly form);
-        # ops.while_loop itself is exercised on the forward-only fetch.
-        def iterated(w_v, b_v, x_v):
-            return jax.lax.fori_loop(
-                0, 3, lambda _, s: jax.nn.sigmoid(w_v * s + b_v), x_v)
-
-        pred = ad.ops.lift(iterated)(W, b, x)
-        loss = ad.ops.reduce_mean(ad.ops.square(pred - y))
-        # same computation through ops.while_loop (forward-only fetch)
+        # under a loop, regress the fixed point onto y — and TRAIN
+        # THROUGH the loop, like tf.while_loop. The bounded form
+        # (max_iters) lowers to a cond-gated scan, which is
+        # reverse-differentiable; the fori_loop formulation is kept as
+        # an equality cross-check of the lowering.
         wl = ad.ops.while_loop(
             lambda carry: carry[0] < 3,
             lambda carry: (carry[0] + 1,
                            jax.nn.sigmoid(carry[1] * carry[2] + carry[3]),
                            carry[2], carry[3]),
-            (ad.ops.constant(0), x, W, b))
-        wl_mean = ad.ops.reduce_mean(wl[1])
-        pred_mean = ad.ops.reduce_mean(pred)
+            (ad.ops.constant(0), x, W, b), max_iters=3)
+        pred = wl[1]
+        loss = ad.ops.reduce_mean(ad.ops.square(pred - y))
+
+        def iterated(w_v, b_v, x_v):
+            return jax.lax.fori_loop(
+                0, 3, lambda _, s: jax.nn.sigmoid(w_v * s + b_v), x_v)
+
+        wl_mean = ad.ops.reduce_mean(pred)
+        pred_mean = ad.ops.reduce_mean(ad.ops.lift(iterated)(W, b, x))
         train_op = ad.optimizers.SGD(0.01).minimize(loss, [W, b])
         sess = autodist.create_distributed_session()
         losses = []
