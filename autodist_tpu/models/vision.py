@@ -78,10 +78,14 @@ class BatchNorm(Module):
     def apply(self, params, x):
         from autodist_tpu.models.core import (is_training,
                                               record_state_update)
-        x32 = x.astype(jnp.float32)
         if is_training():
-            mean = jnp.mean(x32, axis=(0, 1, 2))
-            var = jnp.var(x32, axis=(0, 1, 2))
+            # fused-BN formulation: one pass of f32-ACCUMULATED moments
+            # (E[x], E[x^2]); the f32 convert fuses into the reduces, so
+            # no [B,H,W,C] f32 temporary hits HBM
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            m2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
             m = self.momentum
             record_state_update(
                 self, 'ema_mean', m * params['ema_mean'] + (1 - m) * mean)
@@ -90,9 +94,16 @@ class BatchNorm(Module):
         else:
             mean = params['ema_mean']
             var = params['ema_var']
-        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
-        y = y * params['scale'] + params['bias']
-        return y.astype(self.dtype)
+        # normalize+affine folded to one per-channel multiply-add: the
+        # [C]-vector coefficients are computed in f32, the elementwise
+        # pass over the activations reads and writes the model dtype
+        # (bf16 on TPU) — the round-2 path upcast every activation to
+        # f32 here, doubling the HBM bytes of the BN stage
+        a = params['scale'] * jax.lax.rsqrt(var + self.eps)
+        b = params['bias'] - mean * a
+        y = x.astype(self.dtype) * a.astype(self.dtype) + \
+            b.astype(self.dtype)
+        return y
 
 
 def max_pool(x, window=3, stride=2, padding='SAME'):

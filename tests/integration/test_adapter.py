@@ -119,3 +119,89 @@ def test_c1_loader_driven_session_training(tmp_path):
         W_val, b_val = sess.run([W, b])
     assert losses[-1] < losses[0] * 0.05, losses[::10]
     assert abs(float(W_val) - 4.0) < 0.5 and abs(float(b_val) - 1.0) < 0.5
+
+
+def test_functional_model_adapter_flax_zero_touch():
+    """Zero-touch third-party capture (reference patch.py:96-197 role):
+    an UNMODIFIED flax model — its own init/apply — wrapped in
+    FunctionalModel with a user-supplied logical-axes map drives the
+    full strategy machinery: PSLoadBalancing builds over the param
+    pytree, PartitionedPS shards state over the mesh, and numbers match
+    plain DP."""
+    import flax.linen as nn
+
+    from autodist_tpu.api import Trainer
+    from autodist_tpu.strategy.adapter import FunctionalModel
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(1)(x)
+
+    mod = MLP()
+    rng = np.random.RandomState(0)
+    batch = {'x': rng.randn(64, 8).astype(np.float32),
+             'y': (rng.randn(64, 8) @ rng.randn(8, 1)).astype(np.float32)}
+    example = jnp.zeros((1, 8), jnp.float32)
+
+    def init_fn(key):
+        return mod.init(key, example)['params']
+
+    def loss_fn(params, b):
+        pred = mod.apply({'params': params}, b['x'])
+        return jnp.mean((pred - b['y']) ** 2)
+
+    axes = {'Dense_0': {'kernel': ('in', 'mlp'), 'bias': ('mlp',)},
+            'Dense_1': {'kernel': ('mlp', 'out'), 'bias': ('out',)}}
+    model = FunctionalModel(init_fn, loss_fn, axes=axes)
+
+    def run(trainer):
+        state = trainer.init(jax.random.PRNGKey(0))
+        out = []
+        for _ in range(5):
+            state, m = trainer.step(state, batch)
+            out.append(float(m['loss']))
+        return out
+
+    dp = run(Trainer(model, optax.sgd(0.1), spec=ParallelSpec()))
+    lb = run(trainer_from_strategy(model, optax.sgd(0.1),
+                                   PSLoadBalancing()))
+    tr_part = trainer_from_strategy(model, optax.sgd(0.1),
+                                    PartitionedPS())
+    part = run(tr_part)
+    assert dp[-1] < dp[0]
+    np.testing.assert_allclose(lb, dp, atol=2e-4)
+    np.testing.assert_allclose(part, dp, atol=2e-4)
+    # PartitionedPS actually sharded the flax kernel over the mesh
+    flat = jax.tree_util.tree_leaves_with_path(tr_part.param_shardings)
+    specs = {'/'.join(str(getattr(k, 'key', k)) for k in path):
+             s.spec for path, s in flat}
+    assert any('data' in str(spec) for spec in specs.values()), specs
+
+
+def test_functional_model_adapter_default_axes():
+    """Without an axes map every param is unannotated: the adapter still
+    trains (replicated until a strategy shards it)."""
+    from autodist_tpu.api import Trainer
+    from autodist_tpu.strategy.adapter import FunctionalModel
+
+    def init_fn(key):
+        k1, k2 = jax.random.split(key)
+        return {'w': jax.random.normal(k1, (8, 4)) * 0.1,
+                'b': jnp.zeros((4,))}
+
+    def loss_fn(p, b):
+        return jnp.mean((b['x'] @ p['w'] + p['b'] - b['y']) ** 2)
+
+    rng = np.random.RandomState(1)
+    batch = {'x': rng.randn(32, 8).astype(np.float32),
+             'y': rng.randn(32, 4).astype(np.float32)}
+    model = FunctionalModel(init_fn, loss_fn)
+    tr = Trainer(model, optax.sgd(0.05), spec=ParallelSpec())
+    state = tr.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(4):
+        state, m = tr.step(state, batch)
+        losses.append(float(m['loss']))
+    assert losses[-1] < losses[0]
