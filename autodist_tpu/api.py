@@ -129,12 +129,23 @@ class Trainer:
         flat_shards = jax.tree.leaves(
             param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
         replicated = NamedSharding(self.mesh, P())
-        # shape-keyed fallback for leaves inside states that do not mirror
-        # the param treedef exactly (optax.masked / multi_transform insert
-        # placeholder nodes); ambiguous shapes stay with the first match
+        # Fallbacks for leaves inside states that do not mirror the param
+        # treedef exactly (optax.masked / multi_transform insert
+        # placeholder nodes): first match the leaf's tree PATH against a
+        # param path suffix (state trees nest the param tree under
+        # wrapper keys like inner_state/mu, so param names survive in the
+        # path); only then fall back to shape — and NEVER guess between
+        # same-shape params with different shardings: ambiguous shapes
+        # replicate (correct via resharding, predictable placement).
+        pp = jax.tree_util.tree_flatten_with_path(params)[0]
+        param_paths = []
+        for (path, p), s in zip(pp, flat_shards):
+            keys = tuple(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                         for k in path)
+            param_paths.append((keys, tuple(p.shape), s))
         by_shape = {}
         for p, s in zip(flat_params, flat_shards):
-            by_shape.setdefault(tuple(p.shape), s)
+            by_shape.setdefault(tuple(p.shape), set()).add(s)
 
         def mirrors_params(node):
             try:
@@ -142,7 +153,30 @@ class Trainer:
             except Exception:
                 return False
 
-        def place(node):
+        def place_leaf(path_keys, node):
+            shape = tuple(getattr(node, 'shape', ()))
+            # path-suffix match: unique param whose full path ends the
+            # state leaf's path (and whose shape agrees)
+            cands = [s for keys, pshape, s in param_paths
+                     if pshape == shape and len(path_keys) >= len(keys)
+                     and path_keys[-len(keys):] == keys]
+            if len(set(cands)) == 1:
+                sh = cands[0]
+            else:
+                shs = by_shape.get(shape, set())
+                if len(shs) != 1:
+                    if len(shs) > 1:
+                        logging.debug(
+                            'optimizer leaf %s: shape %s matches params '
+                            'with differing shardings; replicating',
+                            '/'.join(path_keys), shape)
+                    return replicated
+                sh = next(iter(shs))
+            if self.spec.zero >= 2:
+                return self._zero_extend(sh, node.shape)
+            return sh
+
+        def place(path, node):
             if mirrors_params(node):
                 leaves = jax.tree.leaves(node)
                 placed = []
@@ -154,14 +188,12 @@ class Trainer:
                     else:
                         placed.append(sh)
                 return jax.tree.unflatten(param_def, placed)
-            sh = by_shape.get(tuple(getattr(node, 'shape', ())))
-            if sh is None:
-                return replicated
-            if self.spec.zero >= 2:
-                return self._zero_extend(sh, node.shape)
-            return sh
+            keys = tuple(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                         for k in path)
+            return place_leaf(keys, node)
 
-        return jax.tree.map(place, opt_state, is_leaf=mirrors_params)
+        return jax.tree_util.tree_map_with_path(
+            place, opt_state, is_leaf=mirrors_params)
 
     def batch_sharding(self, batch):
         """Leading dim over data; dim 1 over seq for rank>=2 leaves when

@@ -417,7 +417,14 @@ class ExecutionPlan:
         """Reference remapper rule (remapper.py:109-123): split feeds with a
         *polymorphic* (declared-None) batch dim across replicas, duplicate
         the rest. Fixed-shape placeholders are never split, matching the
-        reference's shape-compatibility check."""
+        reference's shape-compatibility check.
+
+        Unlike the reference's ``np.array_split`` (ragged per-replica
+        batches under TF's dynamic shapes), XLA needs static equal
+        shards, so a batch that does not divide the replica count is
+        REPLICATED — numerically exact for mean losses but n× the
+        FLOPs; warned once per placeholder so the cost is never silent.
+        """
         if placeholder is not None:
             shape = getattr(placeholder, 'shape', None)
             if shape is not None and (len(shape) == 0 or
@@ -425,9 +432,25 @@ class ExecutionPlan:
                 return False
         # Feeds are process-local (between-graph semantics): the value only
         # has to split across this process's local replicas.
-        return (getattr(value, 'ndim', 0) >= 1 and
-                value.shape[0] % self.local_replicas == 0 and
-                value.shape[0] > 0)
+        ok = (getattr(value, 'ndim', 0) >= 1 and
+              value.shape[0] % self.local_replicas == 0 and
+              value.shape[0] > 0)
+        if (not ok and self.local_replicas > 1 and
+                getattr(value, 'ndim', 0) >= 1 and value.shape[0] > 0):
+            key = id(placeholder) if placeholder is not None else None
+            if not hasattr(self, '_split_warned'):
+                self._split_warned = set()
+            if key not in self._split_warned:
+                self._split_warned.add(key)
+                logging.warning(
+                    'Feed %s batch dim %d does not divide the %d local '
+                    'replicas; the feed is REPLICATED on every replica '
+                    '(exact numerics, %dx the FLOPs). Pad the batch to '
+                    'a multiple of %d to split it.',
+                    getattr(placeholder, 'name', '<tensor>'),
+                    value.shape[0], self.local_replicas,
+                    self.local_replicas, self.local_replicas)
+        return ok
 
     def describe(self):
         """Human-readable lowering summary (logged like the reference logs
