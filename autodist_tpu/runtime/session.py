@@ -850,6 +850,12 @@ class Session:
         self._closed = True
         if getattr(self, '_hb_stop', None) is not None:
             self._hb_stop.set()
+        for client in getattr(self, '_ps_clients', []):
+            if client is not self._coord:
+                try:
+                    client.close()
+                except OSError:  # pragma: no cover - socket already gone
+                    pass
 
     def __enter__(self):
         return self
