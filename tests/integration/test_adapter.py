@@ -205,3 +205,33 @@ def test_functional_model_adapter_default_axes():
         state, m = tr.step(state, batch)
         losses.append(float(m['loss']))
     assert losses[-1] < losses[0]
+
+
+def test_functional_model_adapter_haiku_zero_touch():
+    """Same zero-touch contract for dm-haiku: hk.transform's own
+    init/apply wrapped unmodified."""
+    import haiku as hk
+
+    from autodist_tpu.api import Trainer
+    from autodist_tpu.strategy.adapter import FunctionalModel
+
+    def net(x):
+        return hk.Linear(1)(jax.nn.relu(hk.Linear(16)(x)))
+
+    transformed = hk.without_apply_rng(hk.transform(net))
+    rng = np.random.RandomState(2)
+    batch = {'x': rng.randn(32, 8).astype(np.float32),
+             'y': rng.randn(32, 1).astype(np.float32)}
+    example = jnp.zeros((1, 8), jnp.float32)
+
+    model = FunctionalModel(
+        init_fn=lambda key: transformed.init(key, example),
+        loss_fn=lambda p, b: jnp.mean(
+            (transformed.apply(p, b['x']) - b['y']) ** 2))
+    tr = trainer_from_strategy(model, optax.sgd(0.05), PSLoadBalancing())
+    state = tr.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(4):
+        state, m = tr.step(state, batch)
+        losses.append(float(m['loss']))
+    assert losses[-1] < losses[0]

@@ -357,6 +357,19 @@ def test_loose_mode_carries_100mb_model_multi_endpoint(tmp_path):
 
 
 @pytest.mark.integration
+def test_bf16_wire_end_to_end(tmp_path):
+    """AUTODIST_PS_WIRE_DTYPE=bf16 halves the PS wire; training still
+    converges through the quantized frames (values f32 at rest)."""
+    body = STALENESS_BODY % {'builder_kwargs': 'staleness=3'}
+    results = launch_pair(tmp_path, body, timeout=420,
+                          extra_env={'AUTODIST_PS_WIRE_DTYPE': 'bf16'})
+    chief = next(r for r in results if r['role'] == 'chief')
+    assert max(chief['lead']) <= 3, chief['lead']
+    for r in results:
+        assert abs(r['b']) > 1e-4
+
+
+@pytest.mark.integration
 def test_clean_peer_shutdown_is_not_a_crash(tmp_path):
     """A peer that finishes its run and closes its session cleanly must
     not be reported as dead: Session.close publishes a done marker and
