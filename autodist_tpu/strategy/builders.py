@@ -266,13 +266,14 @@ class Parallax(StrategyBuilder):
 
     def __init__(self, chunk_size=128, local_proxy_variable=False,
                  sync=True, staleness=0, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor'):
+                 compressor='NoneCompressor', shared_optimizer=False):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._shared_optimizer = shared_optimizer
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -288,7 +289,8 @@ class Parallax(StrategyBuilder):
                     synchronizer=PSSynchronizer(
                         reduction_destination=min_ps,
                         local_replication=self._local_proxy_variable,
-                        sync=self._sync, staleness=self._staleness)))
+                        sync=self._sync, staleness=self._staleness,
+                        shared_optimizer=self._shared_optimizer)))
             else:
                 s.node_config.append(StrategyNode(
                     var_name=var.name,
