@@ -180,19 +180,24 @@ def launch_cli(argv=None):
         '%s:%d' % (chief, DEFAULT_COORD_PORT)
 
     os.makedirs(DEFAULT_WORKING_DIR, exist_ok=True)
-    # The launcher owns the coord service: it must outlive every process
-    # (a fast chief may finish while slow workers still push PS deltas).
-    service_proc = None
+    # The launcher owns the coord service (and any local PS endpoint
+    # services): they must outlive every process (a fast chief may
+    # finish while slow workers still push PS deltas).
+    service_procs = []
     cs_host, cs_port = coord_service.rsplit(':', 1)
     if is_local_address(cs_host):
         from autodist_tpu.runtime import coord_client
         all_local = all(is_local_address(n) for n in nodes)
-        service_proc = coord_client.ensure_service(
-            int(cs_port), bind='127.0.0.1' if all_local else '0.0.0.0')
+        service_procs.append(coord_client.ensure_service(
+            int(cs_port), bind='127.0.0.1' if all_local else '0.0.0.0'))
         if all_local:
             # bound to loopback -> children must connect via loopback,
             # even when the spec names this host by its NIC IP
             coord_service = '127.0.0.1:%s' % cs_port
+        for ep_host, ep_port in coord_client.ps_endpoints():
+            if is_local_address(ep_host):
+                service_procs.append(coord_client.ensure_service(
+                    ep_port, bind='127.0.0.1' if all_local else '0.0.0.0'))
     import uuid
     run_id = uuid.uuid4().hex[:12]
     procs = []
@@ -233,6 +238,7 @@ def launch_cli(argv=None):
     rc = 0
     for p in procs:
         rc = p.wait() or rc
-    if service_proc is not None:
-        service_proc.terminate()
+    for sp in service_procs:
+        if sp is not None:
+            sp.terminate()
     return rc
