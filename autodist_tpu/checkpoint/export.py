@@ -54,7 +54,8 @@ def _input_spec(shape, dtype, scope, sym_names):
 
 def export_servable(fn, params, input_shapes, path,
                     signature='serving_default', tags=('serve',),
-                    platforms=('cpu', 'tpu'), input_names=None):
+                    platforms=('cpu', 'tpu'), input_names=None,
+                    write_params=True):
     """Export ``fn(params, *inputs) -> list of outputs`` as a servable
     bundle.
 
@@ -80,7 +81,10 @@ def export_servable(fn, params, input_shapes, path,
     module_file = 'module.%s.shlo' % signature
     with open(os.path.join(path, module_file), 'wb') as f:
         f.write(exported.serialize())
-    save_pytree(os.path.join(path, 'variables'), host_params)
+    if write_params:
+        # variables/ is signature-independent; multi-signature bundles
+        # pass write_params=False after the first export
+        save_pytree(os.path.join(path, 'variables'), host_params)
 
     meta_path = os.path.join(path, 'saved_model.json')
     meta = {'format': _FORMAT, 'tags': list(tags), 'signatures': {}}

@@ -344,7 +344,8 @@ class SavedModelBuilder:
         from autodist_tpu.frontend import graph as fe
         tree = {name: np.asarray(self._sess.get_variable_value(name))
                 for name in self._sess._graph_item.graph.variables}
-        for sig_name, (outputs, inputs) in self._signatures.items():
+        for i, (sig_name, (outputs, inputs)) in \
+                enumerate(self._signatures.items()):
             out_nodes = outputs if isinstance(outputs, (list, tuple)) \
                 else [outputs]
             out_nodes = [o.read() if isinstance(o, fe.Variable) else o
@@ -367,7 +368,8 @@ class SavedModelBuilder:
                 make_fn(out_nodes, in_phs), tree,
                 [(ph.shape, ph.dtype) for ph in in_phs],
                 self.export_dir, signature=sig_name, tags=self._tags,
-                input_names=[ph.name for ph in in_phs])
+                input_names=[ph.name for ph in in_phs],
+                write_params=(i == 0))
         if not self._signatures:
             save_pytree(os.path.join(self.export_dir, 'variables'), tree)
             meta = {'format': 'autodist_tpu.saved_model.v1',

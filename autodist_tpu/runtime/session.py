@@ -279,11 +279,19 @@ class Session:
         (utils/server_starter.py:48-75). Without endpoints, all variables
         live on the coord service (single-PS layout)."""
         from autodist_tpu.runtime import coord_client as cc
+        from autodist_tpu.runtime.cluster import is_local_address
         eps = cc.ps_endpoints()
         if not eps:
             self._ps_clients = [self._coord]
             return
-        self._ps_clients = [cc.connect_with_retry(ep) for ep in eps]
+        # a locally-hosted endpoint may be bound to loopback (all-local
+        # runs); dialing 127.0.0.1 works under either bind, while the
+        # raw NIC address fails against a loopback bind — same rewrite
+        # the coord-service connection applies (autodist.py)
+        self._ps_clients = [
+            cc.connect_with_retry(
+                ('127.0.0.1' if is_local_address(host) else host, port))
+            for host, port in eps]
         n = len(eps)
         hosts = [h for h, _ in eps]
         dests = sorted({
