@@ -5,3 +5,5 @@ from autodist_tpu.strategy.base import (  # noqa: F401
 from autodist_tpu.strategy.builders import (  # noqa: F401
     PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
     PSLoadBalancing, RandomAxisPartitionAR, UnevenPartitionedPS)
+from autodist_tpu.strategy.adapter import (  # noqa: F401
+    FunctionalModel, PytreeGraphItem, trainer_from_strategy)
