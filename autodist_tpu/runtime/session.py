@@ -47,6 +47,42 @@ class RunOptions:
         self.trace_dir = trace_dir or DEFAULT_TRACE_DIR
 
 
+def assign_ps_endpoints(var_plans, endpoints):
+    """Map each variable to a PS endpoint index.
+
+    Placement honors the strategy's ``reduction_destination``
+    (reference ps_lb_strategy.py:64-83 bin-packing): endpoints
+    co-located on the destination's host are preferred (several on one
+    host spread by destination ordinal); destinations on unknown hosts
+    map by their ordinal among the sorted distinct destinations; vars
+    without a destination hash stably. Pure function so placement is
+    unit-testable and deterministic across processes.
+    """
+    import zlib
+    n = len(endpoints)
+    hosts = [h for h, _ in endpoints]
+    dests = sorted({
+        getattr(p.sync, 'reduction_destination', '')
+        for p in var_plans.values()
+        if p.is_ps and getattr(p.sync, 'reduction_destination', '')})
+    dest_ord = {d: i for i, d in enumerate(dests)}
+    out = {}
+    for name, p in var_plans.items():
+        dest = getattr(p.sync, 'reduction_destination', '') \
+            if p.is_ps else ''
+        if dest:
+            dhost = dest.split(':', 1)[0]
+            cands = [i for i, h in enumerate(hosts) if h == dhost]
+            if cands:
+                idx = cands[dest_ord[dest] % len(cands)]
+            else:
+                idx = dest_ord[dest] % n
+        else:
+            idx = zlib.crc32(name.encode()) % n
+        out[name] = idx
+    return out
+
+
 class Session:
     """Stateful driver over the functional compiled step.
 
@@ -292,32 +328,11 @@ class Session:
             cc.connect_with_retry(
                 ('127.0.0.1' if is_local_address(host) else host, port))
             for host, port in eps]
-        n = len(eps)
-        hosts = [h for h, _ in eps]
-        dests = sorted({
-            getattr(p.sync, 'reduction_destination', '')
-            for p in self._plan.var_plans.values()
-            if p.is_ps and getattr(p.sync, 'reduction_destination', '')})
-        dest_ord = {d: i for i, d in enumerate(dests)}
-        for name, p in self._plan.var_plans.items():
-            dest = getattr(p.sync, 'reduction_destination', '') \
-                if p.is_ps else ''
-            if dest:
-                dhost = dest.split(':', 1)[0]
-                # endpoints co-located on the destination's host; when a
-                # host runs several, spread destinations across them
-                cands = [i for i, h in enumerate(hosts) if h == dhost]
-                if cands:
-                    idx = cands[dest_ord[dest] % len(cands)]
-                else:
-                    idx = dest_ord[dest] % n
-            else:
-                idx = self._stable_idx(name, n)
-            self._ps_index[name] = idx
+        self._ps_index = assign_ps_endpoints(self._plan.var_plans, eps)
         counts = [sum(1 for i in self._ps_index.values() if i == k)
-                  for k in range(n)]
+                  for k in range(len(eps))]
         logging.info('PS data plane: %d endpoints, variables per '
-                     'endpoint %s', n, counts)
+                     'endpoint %s', len(eps), counts)
 
     @staticmethod
     def _stable_idx(name, n):

@@ -1,0 +1,75 @@
+"""PS endpoint placement (loose-mode data plane): the pure mapping
+function that makes PSLoadBalancing's bin-packing load-bearing at
+runtime (reference ps_lb_strategy.py:64-83 + one server per PS node,
+utils/server_starter.py:48-75)."""
+import numpy as np
+import pytest
+
+from autodist_tpu.runtime.coord_client import ps_endpoints
+from autodist_tpu.runtime.session import assign_ps_endpoints
+from autodist_tpu.strategy.base import (AllReduceSynchronizer,
+                                        PSSynchronizer)
+
+
+class _Plan:
+    def __init__(self, sync):
+        self.sync = sync
+        self.is_ps = isinstance(sync, PSSynchronizer)
+
+
+def _ps(dest):
+    return _Plan(PSSynchronizer(reduction_destination=dest))
+
+
+def test_host_match_places_on_colocated_endpoint():
+    plans = {'a': _ps('10.0.0.1:CPU:0'), 'b': _ps('10.0.0.2:CPU:0')}
+    idx = assign_ps_endpoints(plans, [('10.0.0.1', 9000),
+                                      ('10.0.0.2', 9000)])
+    assert idx == {'a': 0, 'b': 1}
+
+
+def test_colocated_endpoints_spread_by_destination():
+    """Two endpoints on ONE host: distinct destinations spread across
+    them instead of collapsing onto the first (round-3 review fix)."""
+    plans = {'a': _ps('10.0.0.5:CPU:0'), 'b': _ps('10.0.0.5:CPU:1')}
+    idx = assign_ps_endpoints(plans, [('10.0.0.5', 9000),
+                                      ('10.0.0.5', 9001)])
+    assert sorted(idx.values()) == [0, 1]
+
+
+def test_unknown_host_maps_by_destination_ordinal():
+    plans = {'a': _ps('nodeA:CPU:0'), 'b': _ps('nodeB:CPU:0'),
+             'c': _ps('nodeA:CPU:0')}
+    idx = assign_ps_endpoints(plans, [('127.0.0.1', 1),
+                                      ('127.0.0.1', 2)])
+    # same destination -> same endpoint; distinct destinations spread
+    assert idx['a'] == idx['c'] != idx['b']
+
+
+def test_no_destination_hashes_stably():
+    plans = {'v%d' % i: _Plan(AllReduceSynchronizer()) for i in range(16)}
+    eps = [('h', 1), ('h', 2), ('h', 3)]
+    idx1 = assign_ps_endpoints(plans, eps)
+    idx2 = assign_ps_endpoints(plans, eps)
+    assert idx1 == idx2                       # deterministic
+    assert len(set(idx1.values())) > 1        # actually spreads
+
+
+def test_mapping_identical_across_orderings():
+    """Chief and workers build the dict in any iteration order; the
+    assignment must agree (it keys only on names/destinations)."""
+    a = {'x': _ps('n1:CPU:0'), 'y': _ps('n2:CPU:0'), 'z': _ps('n1:CPU:0')}
+    b = dict(reversed(list(a.items())))
+    eps = [('n1', 1), ('n2', 1)]
+    assert assign_ps_endpoints(a, eps) == assign_ps_endpoints(b, eps)
+
+
+def test_ps_endpoints_env_parsing(monkeypatch):
+    monkeypatch.setenv('AUTODIST_PS_ENDPOINTS',
+                       ' 10.0.0.1:9000, 10.0.0.2:9001 ,')
+    assert ps_endpoints() == [('10.0.0.1', 9000), ('10.0.0.2', 9001)]
+    monkeypatch.setenv('AUTODIST_PS_ENDPOINTS', 'badentry')
+    with pytest.raises(ValueError, match='host:port'):
+        ps_endpoints()
+    monkeypatch.delenv('AUTODIST_PS_ENDPOINTS')
+    assert ps_endpoints() == []
