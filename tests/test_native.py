@@ -168,6 +168,30 @@ def test_tensor_data_plane_concurrent_pushes(coord):
     np.testing.assert_allclose(c0.vget('acc2'), 10.0)
 
 
+def test_coord_service_survives_malformed_input(coord):
+    """Garbage lines, unknown commands, and bogus binary headers get an
+    ERR reply (or a clean disconnect) without taking the service down
+    for other connections."""
+    import socket as _socket
+    c = coord()
+    c.set('canary', 'alive')
+    addr = c.address
+    for payload in (b'\n', b'NOTACMD x y\n', b'BADD k notanum f32\n',
+                    b'BGET\n', b'BSET k 12 q99\nxxxxxxxxxxxx'):
+        s = _socket.create_connection(addr, timeout=5)
+        s.sendall(payload)
+        try:
+            s.settimeout(5)
+            s.recv(256)   # reply or clean close — either is fine
+        except OSError:
+            pass
+        s.close()
+    # the service is still healthy for existing and new connections
+    assert c.get('canary') == 'alive'
+    c2 = coord()
+    c2.ping()
+
+
 def test_dataloader_native_matches_python(tmp_path):
     rng = np.random.RandomState(0)
     data = rng.randint(0, 1000, (32, 16)).astype(np.int32)
