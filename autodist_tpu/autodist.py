@@ -210,7 +210,7 @@ class AutoDist:
         # so co-located processes race benignly) — endpoints on non-chief
         # PS nodes are started by the worker process running there;
         # variables land on the endpoint their reduction_destination maps
-        # to (session._ps_client_for) — the reference's
+        # to (session.assign_ps_endpoints) — the reference's
         # one-tf.Server-per-PS-node layout (utils/server_starter.py:48-75).
         for ep_host, ep_port in coord_client.ps_endpoints():
             if is_local_address(ep_host):

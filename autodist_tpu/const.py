@@ -80,6 +80,21 @@ class ENV(Enum):
     # wire dtype for PS tensor frames: f32 (default) or bf16 (half the
     # bytes; values are rounded to bf16 on the wire, kept f32 at rest).
     AUTODIST_PS_WIRE_DTYPE = (lambda v: v if v else 'f32',)
+    # PS frame chunking: tensors above this many wire bytes move as
+    # ranged chunks (all B* updates are elementwise, so chunked
+    # application is exact). 0 disables chunking.
+    AUTODIST_PS_CHUNK_BYTES = (lambda v: int(v) if v else 64 << 20,)
+    # shared secret for the coord-service handshake: when set, the
+    # service challenges every connection with a nonce and requires
+    # HMAC-SHA256(token, nonce) before any command. Empty = open
+    # (loopback-only deployments). Forwarded to workers like the other
+    # flags; never passed on argv.
+    AUTODIST_COORD_TOKEN = (lambda v: v if v else '',)
+    # alternative token transport: path to a file holding the secret.
+    # The ssh coordinator ships the token this way (a mode-0600 file
+    # copied like the strategy) because env assignments ride the remote
+    # command line, which is world-readable in `ps` on the worker host.
+    AUTODIST_COORD_TOKEN_FILE = (lambda v: v if v else '',)
 
     @property
     def val(self):

@@ -30,10 +30,13 @@ class Optimizer:
     PartitionSpec as the variable itself (ZeRO-style PS realization).
     """
 
-    # SGD-family optimizers publish their scalar hyperparameters here so
+    # Optimizers with one of the service's update rules (sgd/momentum,
+    # adam, adagrad — coord_service BSTEP) publish
+    # ``{'rule': <name>, 'params': [<scalar hyperparameters>]}`` here so
     # loose-mode PS sessions can run the update step ON the PS with
-    # shared slot state (coord_service BSTEP); None = PS-side apply
-    # unsupported, worker-local slots are used.
+    # shared slot state (the reference re-creates the user's optimizer
+    # over PS-resident variables, kernel/partitioner.py:570-573);
+    # None = PS-side apply unsupported, worker-local slots are used.
     ps_step_params = None
 
     def __init__(self, tx, name=None, _capture=None):
@@ -101,8 +104,9 @@ class SGD(Optimizer):
         if not nesterov and isinstance(learning_rate, (int, float)):
             # BSTEP implements vel = m*vel + g; w -= lr*vel (optax.sgd's
             # trace form); nesterov variants stay worker-local
-            self.ps_step_params = {'lr': float(learning_rate),
-                                   'momentum': float(momentum)}
+            self.ps_step_params = {
+                'rule': 'sgd',
+                'params': [float(learning_rate), float(momentum)]}
 
 
 GradientDescent = SGD
@@ -121,6 +125,14 @@ class Adam(Optimizer):
             name, _capture=('Adam', (learning_rate,),
                             {'beta_1': beta_1, 'beta_2': beta_2,
                              'epsilon': epsilon}))
+        if isinstance(learning_rate, (int, float)):
+            # BSTEP adam matches optax.adam (bias-corrected moments,
+            # eps outside the sqrt); the step index t is PS-resident
+            # and shared, like the moments
+            self.ps_step_params = {
+                'rule': 'adam',
+                'params': [float(learning_rate), float(beta_1),
+                           float(beta_2), float(epsilon)]}
 
 
 class AdamW(Optimizer):
@@ -141,6 +153,11 @@ class Adagrad(Optimizer):
                           initial_accumulator_value=initial_accumulator_value,
                           eps=epsilon),
             name, _capture=('Adagrad', (learning_rate,), {}))
+        if isinstance(learning_rate, (int, float)):
+            self.ps_step_params = {
+                'rule': 'adagrad',
+                'params': [float(learning_rate), float(epsilon),
+                           float(initial_accumulator_value)]}
 
 
 class RMSProp(Optimizer):

@@ -23,33 +23,60 @@
 // never serializes against another variable's pull; a run hosts one
 // service per PS endpoint (ps_lb_strategy.py:64-83 bin-packing made
 // load-bearing: variables land on the endpoint their
-// reduction_destination resolves to).
+// reduction_destination resolves to, per SHARD for partitioned
+// variables — partitioned_ps_strategy.py:89-96 round-robin placement).
 //
-// BSTEP additionally keeps the optimizer step ON the PS (the reference
-// re-creates the optimizer over PS-resident variables so async workers
+// All B* commands accept an optional trailing `<off_elems> <total_elems>`
+// range so large tensors move as bounded chunks (the client splits
+// frames above AUTODIST_PS_CHUNK_BYTES): every update rule here is
+// elementwise, so ranged application is exact. A logical push counts
+// once, at its offset-0 chunk.
+//
+// BSTEP keeps the optimizer step ON the PS (the reference re-creates
+// the user's optimizer over PS-resident variables so async workers
 // share slot state, kernel/partitioner.py:570-573): workers push raw
-// gradients and the service applies SGD/momentum with a PS-resident
-// velocity slot shared by all workers.
+// gradients and the service applies the named update rule with PS-
+// resident slots shared by all workers. Rules (optax-matching forms):
+//   sgd      p0=lr p1=momentum   vel = m*vel + g; w -= lr*vel
+//   adam     p0=lr p1=b1 p2=b2 p3=eps
+//            m=b1*m+(1-b1)g; v=b2*v+(1-b2)g^2;
+//            w -= lr * (m/(1-b1^t)) / (sqrt(v/(1-b2^t)) + eps)
+//   adagrad  p0=lr p1=eps p2=init_acc
+//            acc += g^2; w -= lr * g / (sqrt(acc) + eps)
+// The adam step index t is shared: a push's offset-0 chunk with t=0
+// bumps the tensor's counter and the reply returns the t used; later
+// chunks of the same push pass that t explicitly.
+//
+// Authentication: when the service is started with AUTODIST_COORD_TOKEN
+// set, every connection is greeted with `HELLO <nonce>` and must present
+// `AUTH <hex hmac-sha256(token, nonce)>` before any other command
+// (without a token the greeting is `HELLO open`). The reference's
+// control plane rode authenticated SSH (coordinator.py:46-90); an open
+// TCP port on a multi-host NIC needs at least this shared-secret
+// handshake.
 //
 // Protocol: newline-terminated text commands over TCP; the B* commands
 // carry a length-prefixed raw payload immediately after the newline.
+//   AUTH <hmac-hex>              -> OK | ERR (connection greeting reply)
 //   SET <key> <value>            -> OK
 //   GET <key>                    -> VAL <value> | NONE
 //   DEL <key>                    -> OK
+//   DELNS <prefix>               -> VAL <n>  (purge keys/counters/tensors
+//                                    /barriers under prefix: run-end
+//                                    cleanup for long-lived endpoints)
 //   INCR <key> <delta>           -> VAL <n>        (atomic add, int64)
 //   WAITGE <key> <n> <ms>        -> VAL <m> | TIMEOUT   (wait key >= n)
 //   MINWAIT <prefix> <n> <k> <ms>-> VAL <min> | TIMEOUT
 //       (wait until >=k keys share <prefix> and their min value >= n)
 //   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
-//   BSET <key> <nbytes> <wire>   [payload] -> OK
+//   BSET <key> <nbytes> <wire> [<off> <total>]  [payload] -> OK
 //       (store tensor; wire dtype f32|bf16, stored as f32)
-//   BGET <key> <wire>            -> VAL <nbytes>\n[payload] | NONE
-//   BADD <key> <nbytes> <wire>   [payload] -> VAL <n>
+//   BGET <key> <wire> [<off> <count>] -> VAL <nbytes>\n[payload] | NONE
+//   BADD <key> <nbytes> <wire> [<off> <total>]  [payload] -> VAL <n>
 //       (atomic elementwise += ; creates the tensor if absent; returns
 //        the tensor's accumulated push count)
-//   BSTEP <key> <nbytes> <wire> <lr> <momentum> [payload] -> VAL <n>
-//       (payload is a GRADIENT; service applies vel = m*vel + g,
-//        tensor -= lr*vel with the velocity slot resident here)
+//   BSTEP <key> <nbytes> <wire> <rule> <t> <p0> <p1> <p2> <p3>
+//         [<off> <total>]        [payload] -> VAL <t_used>
 //   PING                         -> PONG
 //   SHUTDOWN                     -> OK (server exits)
 //
@@ -62,9 +89,11 @@
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -76,14 +105,21 @@
 
 namespace {
 
+// Declared payload sizes above this are refused outright (ADVICE r3:
+// an unvalidated size_t let a malformed header buffer unbounded bytes).
+constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GB per frame
+constexpr size_t kBadPayload = static_cast<size_t>(-1);
+
 // A stored tensor. `mu` serializes element updates per KEY (not
 // globally): the scoped-allocator-scale concern of one global lock over
 // all variables does not exist here.
 struct Tensor {
   std::mutex mu;
   std::vector<float> data;
-  std::vector<float> vel;  // PS-resident momentum slot (BSTEP)
+  std::vector<float> slot1;  // PS-resident momentum / adam first moment
+  std::vector<float> slot2;  // adam second moment / adagrad accumulator
   int64_t pushes = 0;
+  int64_t steps = 0;  // BSTEP optimizer-step counter (adam bias t)
 };
 
 struct Store {
@@ -98,6 +134,7 @@ struct Store {
 };
 
 Store g_store;
+std::string g_token;  // empty = open service (loopback-only deployments)
 
 std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
   std::lock_guard<std::mutex> l(g_store.mu);
@@ -107,6 +144,145 @@ std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
   auto t = std::make_shared<Tensor>();
   g_store.tensors[key] = t;
   return t;
+}
+
+// -- sha256 / hmac (handshake) -----------------------------------------------
+// Compact FIPS-180-4 SHA-256; no external crypto dependency.
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t len = 0;
+  size_t fill = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[i * 4]) << 24) | (uint32_t(p[i * 4 + 1]) << 16) |
+             (uint32_t(p[i * 4 + 2]) << 8) | uint32_t(p[i * 4 + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^
+                    (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^
+                    (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len += n;
+    while (n) {
+      size_t take = std::min(n, sizeof(buf) - fill);
+      memcpy(buf + fill, p, take);
+      fill += take; p += take; n -= take;
+      if (fill == sizeof(buf)) { block(buf); fill = 0; }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (fill != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[i * 4] = uint8_t(h[i] >> 24);
+      out[i * 4 + 1] = uint8_t(h[i] >> 16);
+      out[i * 4 + 2] = uint8_t(h[i] >> 8);
+      out[i * 4 + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void hmac_sha256(const std::string& key, const std::string& msg,
+                 uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 s; s.update(key.data(), key.size()); s.final(k);
+  } else {
+    memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c; }
+  uint8_t inner[32];
+  Sha256 si; si.update(ipad, 64); si.update(msg.data(), msg.size());
+  si.final(inner);
+  Sha256 so; so.update(opad, 64); so.update(inner, 32); so.final(out);
+}
+
+std::string to_hex(const uint8_t* p, size_t n) {
+  static const char* d = "0123456789abcdef";
+  std::string out(n * 2, '0');
+  for (size_t i = 0; i < n; ++i) {
+    out[2 * i] = d[p[i] >> 4];
+    out[2 * i + 1] = d[p[i] & 15];
+  }
+  return out;
+}
+
+std::string make_nonce() {
+  uint8_t raw[16];
+  FILE* f = fopen("/dev/urandom", "rb");
+  size_t got = f ? fread(raw, 1, sizeof(raw), f) : 0;
+  if (f) fclose(f);
+  if (got != sizeof(raw)) {  // degraded fallback: clock + counter
+    static std::atomic<uint64_t> ctr{0};
+    uint64_t a = std::chrono::steady_clock::now().time_since_epoch().count();
+    uint64_t b = ++ctr + (uint64_t)getpid();
+    memcpy(raw, &a, 8);
+    memcpy(raw + 8, &b, 8);
+  }
+  return to_hex(raw, sizeof(raw));
+}
+
+bool constant_time_eq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char diff = 0;
+  for (size_t i = 0; i < a.size(); ++i) diff |= a[i] ^ b[i];
+  return diff == 0;
 }
 
 // -- wire dtypes -------------------------------------------------------------
@@ -151,16 +327,16 @@ bool decode_wire(const std::string& payload, const std::string& wire,
   return false;
 }
 
-bool encode_wire(const std::vector<float>& v, const std::string& wire,
+bool encode_wire(const float* v, size_t n, const std::string& wire,
                  std::string* out) {
   if (wire == "f32") {
-    out->assign(reinterpret_cast<const char*>(v.data()), v.size() * 4);
+    out->assign(reinterpret_cast<const char*>(v), n * 4);
     return true;
   }
   if (wire == "bf16") {
-    out->resize(v.size() * 2);
+    out->resize(n * 2);
     uint16_t* dst = reinterpret_cast<uint16_t*>(&(*out)[0]);
-    for (size_t i = 0; i < v.size(); ++i) dst[i] = f32_to_bf16(v[i]);
+    for (size_t i = 0; i < n; ++i) dst[i] = f32_to_bf16(v[i]);
     return true;
   }
   return false;
@@ -186,15 +362,51 @@ int64_t prefix_min(const std::string& prefix, int* count) {
   return n ? min_v : 0;
 }
 
-// Payload bytes that follow the header line, or 0 for text commands.
+template <typename M>
+size_t erase_prefix(M* m, const std::string& prefix) {
+  size_t n = 0;
+  auto it = m->lower_bound(prefix);
+  while (it != m->end() &&
+         it->first.compare(0, prefix.size(), prefix) == 0) {
+    it = m->erase(it);
+    ++n;
+  }
+  return n;
+}
+
+// Payload bytes that follow the header line, or 0 for text commands;
+// kBadPayload for an unparsable or over-cap declaration.
 size_t payload_size(const std::string& line) {
   std::istringstream in(line);
   std::string cmd, key;
   in >> cmd;
   if (cmd != "BSET" && cmd != "BADD" && cmd != "BSTEP") return 0;
-  size_t nbytes = 0;
+  uint64_t nbytes = 0;
   in >> key >> nbytes;
-  return nbytes;
+  if (in.fail() || nbytes > kMaxPayload) return kBadPayload;
+  return static_cast<size_t>(nbytes);
+}
+
+// Optional trailing `<off> <total>` range on a B* command; defaults to
+// the whole tensor (off 0, total = payload elements). The declared
+// total is capped like the payload itself (kMaxPayload bytes of f32) —
+// an unvalidated total would let one malformed command allocate
+// int64-max floats and bad_alloc the service.
+bool read_range(std::istringstream* in, size_t n_elems, size_t* off,
+                size_t* total) {
+  constexpr int64_t kMaxElems =
+      static_cast<int64_t>(kMaxPayload / sizeof(float));
+  *off = 0;
+  *total = n_elems;
+  int64_t o = -1, t = -1;
+  if (*in >> o >> t) {
+    if (o < 0 || t < 0 || t > kMaxElems ||
+        static_cast<size_t>(o) + n_elems > static_cast<size_t>(t))
+      return false;
+    *off = static_cast<size_t>(o);
+    *total = static_cast<size_t>(t);
+  }
+  return true;
 }
 
 // Handles one request. `payload` holds the request's raw bytes (B*
@@ -231,6 +443,21 @@ std::string handle(const std::string& line, const std::string& payload,
     g_store.kv.erase(k);
     g_store.counters.erase(k);
     return "OK";
+  }
+  if (cmd == "DELNS") {
+    // run-end cleanup: a long-lived endpoint daemon must not accumulate
+    // a dead run's multi-hundred-MB tensors (ADVICE r3)
+    std::string prefix;
+    in >> prefix;
+    if (prefix.empty()) return "ERR empty prefix";
+    std::lock_guard<std::mutex> l(g_store.mu);
+    size_t n = erase_prefix(&g_store.kv, prefix);
+    n += erase_prefix(&g_store.counters, prefix);
+    n += erase_prefix(&g_store.tensors, prefix);
+    n += erase_prefix(&g_store.barrier_arrivals, prefix);
+    n += erase_prefix(&g_store.barrier_generation, prefix);
+    g_store.cv.notify_all();
+    return "VAL " + std::to_string(n);
   }
   if (cmd == "INCR") {
     std::string k;
@@ -299,12 +526,36 @@ std::string handle(const std::string& line, const std::string& payload,
     in >> k >> nbytes >> wire;
     std::vector<float> vals;
     if (!decode_wire(payload, wire, &vals)) return "ERR bad payload";
+    size_t off, total;
+    if (!read_range(&in, vals.size(), &off, &total))
+      return "ERR bad range";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    t->data = std::move(vals);
-    t->vel.clear();
-    t->pushes = 0;
+    if (off == 0) {  // a (re)set starts at its first chunk
+      t->data.assign(total, 0.f);
+      t->slot1.clear();
+      t->slot2.clear();
+      t->pushes = 0;
+      t->steps = 0;
+    }
+    if (t->data.size() != total) return "ERR shape mismatch";
+    std::copy(vals.begin(), vals.end(), t->data.begin() + off);
     return "OK";
+  }
+  if (cmd == "BSTAT") {
+    // tensor introspection: pushes, optimizer steps, element count,
+    // slot residency — lets tests/tools verify PS-resident optimizer
+    // state (shared adam: steps == total pushes across workers)
+    std::string k;
+    in >> k;
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
+    if (!t) return "NONE";
+    std::lock_guard<std::mutex> l(t->mu);
+    return "VAL " + std::to_string(t->pushes) + " " +
+           std::to_string(t->steps) + " " +
+           std::to_string(t->data.size()) + " " +
+           std::to_string(t->slot1.empty() ? 0 : 1) + " " +
+           std::to_string(t->slot2.empty() ? 0 : 1);
   }
   if (cmd == "BGET") {
     std::string k, wire;
@@ -314,7 +565,17 @@ std::string handle(const std::string& line, const std::string& payload,
     if (!t) return "NONE";
     {
       std::lock_guard<std::mutex> l(t->mu);
-      if (!encode_wire(t->data, wire, reply_payload))
+      size_t off = 0, count = t->data.size();
+      int64_t o = -1, c = -1;
+      if (in >> o >> c) {
+        if (o < 0 || c < 0 ||
+            static_cast<size_t>(o) + static_cast<size_t>(c) >
+                t->data.size())
+          return "ERR bad range";
+        off = static_cast<size_t>(o);
+        count = static_cast<size_t>(c);
+      }
+      if (!encode_wire(t->data.data() + off, count, wire, reply_payload))
         return "ERR bad wire dtype";
     }
     return "VAL " + std::to_string(reply_payload->size());
@@ -325,39 +586,88 @@ std::string handle(const std::string& line, const std::string& payload,
     in >> k >> nbytes >> wire;
     std::vector<float> delta;
     if (!decode_wire(payload, wire, &delta)) return "ERR bad payload";
+    size_t off, total;
+    if (!read_range(&in, delta.size(), &off, &total))
+      return "ERR bad range";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    if (t->data.empty()) t->data.assign(delta.size(), 0.f);
-    if (t->data.size() != delta.size()) return "ERR shape mismatch";
-    for (size_t i = 0; i < delta.size(); ++i) t->data[i] += delta[i];
-    return "VAL " + std::to_string(++t->pushes);
+    if (t->data.empty()) t->data.assign(total, 0.f);
+    if (t->data.size() != total) return "ERR shape mismatch";
+    for (size_t i = 0; i < delta.size(); ++i)
+      t->data[off + i] += delta[i];
+    if (off == 0) ++t->pushes;  // one logical push counts once
+    return "VAL " + std::to_string(t->pushes);
   }
   if (cmd == "BSTEP") {
-    std::string k, wire;
+    std::string k, wire, rule;
     size_t nbytes = 0;
-    double lr = 0.0, momentum = 0.0;
-    in >> k >> nbytes >> wire >> lr >> momentum;
+    int64_t t_in = 0;
+    double p0 = 0, p1 = 0, p2 = 0, p3 = 0;
+    in >> k >> nbytes >> wire >> rule >> t_in >> p0 >> p1 >> p2 >> p3;
     std::vector<float> grad;
     if (!decode_wire(payload, wire, &grad)) return "ERR bad payload";
+    size_t off, total;
+    if (!read_range(&in, grad.size(), &off, &total))
+      return "ERR bad range";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "ERR no tensor";
     std::lock_guard<std::mutex> l(t->mu);
-    if (t->data.size() != grad.size()) return "ERR shape mismatch";
-    if (momentum != 0.0 && t->vel.empty())
-      t->vel.assign(grad.size(), 0.f);
-    if (momentum != 0.0) {
-      const float m = static_cast<float>(momentum);
-      const float a = static_cast<float>(lr);
-      for (size_t i = 0; i < grad.size(); ++i) {
-        t->vel[i] = m * t->vel[i] + grad[i];
-        t->data[i] -= a * t->vel[i];
+    if (t->data.size() != total) return "ERR shape mismatch";
+    int64_t step = t_in;
+    if (off == 0 && step == 0) step = ++t->steps;
+    if (step <= 0) return "ERR bad step";
+    float* w = t->data.data() + off;
+    const float* g = grad.data();
+    const size_t n = grad.size();
+    const float lr = static_cast<float>(p0);
+    if (rule == "sgd") {
+      const float m = static_cast<float>(p1);
+      if (m != 0.f) {
+        if (t->slot1.empty()) t->slot1.assign(total, 0.f);
+        if (t->slot1.size() != total) return "ERR slot mismatch";
+        float* vel = t->slot1.data() + off;
+        for (size_t i = 0; i < n; ++i) {
+          vel[i] = m * vel[i] + g[i];
+          w[i] -= lr * vel[i];
+        }
+      } else {
+        for (size_t i = 0; i < n; ++i) w[i] -= lr * g[i];
+      }
+    } else if (rule == "adam") {
+      const float b1 = static_cast<float>(p1);
+      const float b2 = static_cast<float>(p2);
+      const float eps = static_cast<float>(p3);
+      if (t->slot1.empty()) t->slot1.assign(total, 0.f);
+      if (t->slot2.empty()) t->slot2.assign(total, 0.f);
+      if (t->slot1.size() != total || t->slot2.size() != total)
+        return "ERR slot mismatch";
+      float* m = t->slot1.data() + off;
+      float* v = t->slot2.data() + off;
+      const float c1 =
+          1.f - static_cast<float>(std::pow((double)b1, (double)step));
+      const float c2 =
+          1.f - static_cast<float>(std::pow((double)b2, (double)step));
+      for (size_t i = 0; i < n; ++i) {
+        m[i] = b1 * m[i] + (1.f - b1) * g[i];
+        v[i] = b2 * v[i] + (1.f - b2) * g[i] * g[i];
+        const float mhat = m[i] / c1;
+        const float vhat = v[i] / c2;
+        w[i] -= lr * mhat / (std::sqrt(vhat) + eps);
+      }
+    } else if (rule == "adagrad") {
+      const float eps = static_cast<float>(p1);
+      const float init_acc = static_cast<float>(p2);
+      if (t->slot2.empty()) t->slot2.assign(total, init_acc);
+      if (t->slot2.size() != total) return "ERR slot mismatch";
+      float* acc = t->slot2.data() + off;
+      for (size_t i = 0; i < n; ++i) {
+        acc[i] += g[i] * g[i];
+        w[i] -= lr * g[i] / (std::sqrt(acc[i]) + eps);
       }
     } else {
-      const float a = static_cast<float>(lr);
-      for (size_t i = 0; i < grad.size(); ++i)
-        t->data[i] -= a * grad[i];
+      return "ERR unknown rule";
     }
-    return "VAL " + std::to_string(++t->pushes);
+    return "VAL " + std::to_string(step);
   }
   if (cmd == "SHUTDOWN") {
     std::lock_guard<std::mutex> l(g_store.mu);
@@ -378,25 +688,74 @@ bool send_all(int fd, const char* data, size_t len) {
   return true;
 }
 
+// Reads the next newline-terminated header line into *line; false on EOF.
+bool read_line(int fd, std::string* buf, std::string* line) {
+  char chunk[1 << 16];
+  size_t pos;
+  while ((pos = buf->find('\n')) == std::string::npos) {
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buf->append(chunk, n);
+  }
+  *line = buf->substr(0, pos);
+  buf->erase(0, pos + 1);
+  if (!line->empty() && line->back() == '\r') line->pop_back();
+  return true;
+}
+
 void serve_conn(int fd) {
   std::string buf;
   char chunk[1 << 16];
-  while (!g_store.shutting_down) {
-    // one header line
-    size_t pos;
-    while ((pos = buf.find('\n')) == std::string::npos) {
-      ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
-      if (n <= 0) {
+  // greeting + handshake: with a token configured every connection must
+  // answer the nonce challenge before its first real command
+  {
+    std::string nonce = g_token.empty() ? "" : make_nonce();
+    std::string hello =
+        "HELLO " + (g_token.empty() ? std::string("open") : nonce) + "\n";
+    if (!send_all(fd, hello.data(), hello.size())) {
+      close(fd);
+      return;
+    }
+    if (!g_token.empty()) {
+      std::string line;
+      if (!read_line(fd, &buf, &line)) {
         close(fd);
         return;
       }
-      buf.append(chunk, n);
+      std::istringstream in(line);
+      std::string cmd, mac;
+      in >> cmd >> mac;
+      uint8_t want[32];
+      hmac_sha256(g_token, nonce, want);
+      if (cmd != "AUTH" || !constant_time_eq(mac, to_hex(want, 32))) {
+        const char* err = "ERR auth failed\n";
+        send_all(fd, err, strlen(err));
+        close(fd);
+        return;
+      }
+      const char* ok = "OK\n";
+      if (!send_all(fd, ok, strlen(ok))) {
+        close(fd);
+        return;
+      }
     }
-    std::string line = buf.substr(0, pos);
-    buf.erase(0, pos + 1);
-    if (!line.empty() && line.back() == '\r') line.pop_back();
+  }
+  while (!g_store.shutting_down) {
+    std::string line;
+    if (!read_line(fd, &buf, &line)) {
+      close(fd);
+      return;
+    }
     // then that command's declared payload bytes
     size_t need = payload_size(line);
+    if (need == kBadPayload) {
+      // refuse oversized/garbage declarations instead of buffering
+      // toward them (ADVICE r3); the stream is now unframed, so close
+      const char* err = "ERR payload too large\n";
+      send_all(fd, err, strlen(err));
+      close(fd);
+      return;
+    }
     while (buf.size() < need) {
       ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
       if (n <= 0) {
@@ -430,6 +789,10 @@ int main(int argc, char** argv) {
   // Bind address: second arg; loopback unless the launcher asks for more
   // (multi-host runs pass 0.0.0.0 or the coordinator interface).
   const char* bind_addr = argc > 2 ? argv[2] : "127.0.0.1";
+  // Shared secret from the environment (never argv: visible in ps);
+  // multi-host launchers distribute it via the forwarded ENV set.
+  const char* token = getenv("AUTODIST_COORD_TOKEN");
+  if (token) g_token = token;
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -445,7 +808,8 @@ int main(int argc, char** argv) {
     perror("listen");
     return 1;
   }
-  fprintf(stderr, "coord_service listening on :%d\n", port);
+  fprintf(stderr, "coord_service listening on :%d (%s)\n", port,
+          g_token.empty() ? "open" : "authenticated");
   fflush(stderr);
   std::vector<std::thread> threads;
   while (!g_store.shutting_down) {

@@ -21,8 +21,16 @@ from autodist_tpu.utils import logging
 
 def is_local_address(address):
     """Loopback/local-host detection (reference utils/network.py:22-57)."""
-    if address in ('localhost', '127.0.0.1', '0.0.0.0'):
+    if address in ('localhost', '0.0.0.0'):
         return True
+    try:
+        # any loopback /8 IP — but ONLY a literal IP ('127.foo.com' is
+        # a legal remote hostname, not loopback)
+        import ipaddress
+        if ipaddress.ip_address(address).is_loopback:
+            return True
+    except ValueError:
+        pass
     try:
         local = {socket.gethostname(), socket.getfqdn()}
         local_ips = set()

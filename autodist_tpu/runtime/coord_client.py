@@ -20,6 +20,9 @@ wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at rest on the service. This is
 the grpc-data-plane equivalent the reference rode for PS traffic; base64
 text framing (33% inflation, full-line buffering) is gone.
 """
+import hashlib
+import hmac as hmac_mod
+import os
 import socket
 import subprocess
 import time
@@ -34,6 +37,26 @@ try:
     _BF16 = np.dtype(ml_dtypes.bfloat16)
 except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     _BF16 = None
+
+
+def coord_token():
+    """The coord-service shared secret, or '' for an open service.
+
+    Resolution order: ``AUTODIST_COORD_TOKEN`` (direct env), then
+    ``AUTODIST_COORD_TOKEN_FILE`` (the ssh coordinator ships the secret
+    as a mode-0600 file because env assignments ride the remote command
+    line, world-readable in ``ps``)."""
+    token = ENV.AUTODIST_COORD_TOKEN.val
+    if token:
+        return token
+    path = ENV.AUTODIST_COORD_TOKEN_FILE.val
+    if path:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            logging.warning('coord token file %s unreadable', path)
+    return ''
 
 
 def _wire_dtype(wire=None):
@@ -76,9 +99,15 @@ def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
         pass
     from autodist_tpu.native_build import build
     binary = build('coord_service.cc')
+    env = dict(os.environ)
+    token = coord_token()
+    if token:
+        # the service reads the secret from its environment only (argv
+        # would be visible in ps); resolve token-file transport here
+        env['AUTODIST_COORD_TOKEN'] = token
     proc = subprocess.Popen([binary, str(port), bind],
                             stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+                            stderr=subprocess.DEVNULL, env=env)
     deadline = time.time() + wait_s
     while time.time() < deadline:
         try:
@@ -149,6 +178,49 @@ class CoordClient:
         self._sock = socket.create_connection(address, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b''
+        self._handshake()
+
+    def _read_reply_line(self):
+        while b'\n' not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise OSError('coord_service closed connection')
+            self._buf += chunk
+        resp, self._buf = self._buf.split(b'\n', 1)
+        return resp.decode()
+
+    def _handshake(self):
+        """Consume the service greeting; answer the nonce challenge when
+        the service is token-protected (HELLO <nonce> -> AUTH
+        hmac-sha256(token, nonce))."""
+        greeting = self._read_reply_line()
+        parts = greeting.split()
+        if len(parts) != 2 or parts[0] != 'HELLO':
+            # whatever is on this port, it is not a coord service
+            raise OSError('unexpected greeting %r' % greeting[:64])
+        if parts[1] == 'open':
+            if coord_token():
+                # no silent auth downgrade: a configured token means the
+                # operator expects every endpoint authenticated — an
+                # open listener here is a stale/spoofed service
+                raise OSError(
+                    'coord service at %s is UNAUTHENTICATED but an '
+                    'AUTODIST_COORD_TOKEN is configured — refusing the '
+                    'auth downgrade (stale or spoofed service?)'
+                    % (self.address,))
+            return
+        token = coord_token()
+        if not token:
+            raise OSError(
+                'coord service at %s requires authentication but no '
+                'AUTODIST_COORD_TOKEN(_FILE) is configured'
+                % (self.address,))
+        mac = hmac_mod.new(token.encode(), parts[1].encode(),
+                           hashlib.sha256).hexdigest()
+        self._sock.sendall(('AUTH %s\n' % mac).encode())
+        resp = self._read_reply_line()
+        if resp != 'OK':
+            raise OSError('coord service rejected auth: %s' % resp)
 
     def _rpc(self, line, payload=None):
         """Send one request (header line + optional raw payload), read the
@@ -162,13 +234,7 @@ class CoordClient:
             self._sock.sendall(payload)
         else:
             self._sock.sendall(header + payload if payload else header)
-        while b'\n' not in self._buf:
-            chunk = self._sock.recv(65536)
-            if not chunk:
-                raise OSError('coord_service closed connection')
-            self._buf += chunk
-        resp, self._buf = self._buf.split(b'\n', 1)
-        return resp.decode()
+        return self._read_reply_line()
 
     def _read_exact(self, nbytes):
         """Read exactly ``nbytes`` of reply payload (after a VAL header)."""
@@ -241,25 +307,60 @@ class CoordClient:
             pass
 
     # -- tensor data plane (PS accumulator equivalent) ---------------------
+    @staticmethod
+    def _chunk_elems(wire):
+        """Elements per frame chunk (AUTODIST_PS_CHUNK_BYTES of wire
+        bytes); 0 disables chunking."""
+        limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
+        if not limit:
+            return 0
+        return max(1, limit // (2 if wire == 'bf16' else 4))
+
+    def _ranges(self, n_elems, wire):
+        """Chunk ranges [(off, count)] covering ``n_elems``; a single
+        (0, n) range means 'send unranged' (whole-tensor frame)."""
+        chunk = self._chunk_elems(wire)
+        if not chunk or n_elems <= chunk:
+            return [(0, n_elems)]
+        return [(off, min(chunk, n_elems - off))
+                for off in range(0, n_elems, chunk)]
+
     def vset(self, key, value, wire=None):
         """Store a tensor (authoritative PS copy). Stored f32; wire dtype
-        per ``AUTODIST_PS_WIRE_DTYPE``."""
+        per ``AUTODIST_PS_WIRE_DTYPE``; frames above the chunk limit move
+        as ranged chunks (elementwise, so chunked application is exact)."""
         wire = _wire_dtype(wire)
-        payload = _encode(value, wire)
-        resp = self._rpc('BSET %s %d %s' % (key, len(payload), wire),
-                         payload)
-        if resp != 'OK':
-            raise OSError('BSET %s failed: %s' % (key, resp))
+        flat = np.ascontiguousarray(
+            np.asarray(value, dtype=np.float32)).reshape(-1)
+        ranges = self._ranges(flat.size, wire)
+        for off, count in ranges:
+            payload = _encode(flat[off:off + count], wire)
+            suffix = '' if len(ranges) == 1 else \
+                ' %d %d' % (off, flat.size)
+            resp = self._rpc('BSET %s %d %s%s'
+                             % (key, len(payload), wire, suffix), payload)
+            if resp != 'OK':
+                raise OSError('BSET %s failed: %s' % (key, resp))
 
     def vget(self, key, shape=None, dtype=np.float32, wire=None):
-        """Fetch a tensor as float32 host array, or None if absent."""
+        """Fetch a tensor as float32 host array, or None if absent.
+        With a known ``shape``, oversized tensors are pulled as ranged
+        chunks."""
         wire = _wire_dtype(wire)
-        resp = self._rpc('BGET %s %s' % (key, wire))
-        if resp == 'NONE':
-            return None
-        if not resp.startswith('VAL'):
-            raise OSError('BGET %s failed: %s' % (key, resp))
-        arr = _decode(self._read_exact(int(resp[4:])), wire)
+        n_elems = int(np.prod(shape)) if shape is not None else None
+        ranges = self._ranges(n_elems, wire) if n_elems else [(0, None)]
+        parts = []
+        for off, count in ranges:
+            suffix = '' if len(ranges) == 1 and off == 0 and \
+                (count is None or count == n_elems) else \
+                ' %d %d' % (off, count)
+            resp = self._rpc('BGET %s %s%s' % (key, wire, suffix))
+            if resp == 'NONE':
+                return None
+            if not resp.startswith('VAL'):
+                raise OSError('BGET %s failed: %s' % (key, resp))
+            parts.append(_decode(self._read_exact(int(resp[4:])), wire))
+        arr = parts[0] if len(parts) == 1 else np.concatenate(parts)
         if shape is not None:
             arr = arr.reshape(shape)
         return arr.astype(dtype, copy=False)
@@ -268,27 +369,75 @@ class CoordClient:
         """Atomically add a delta elementwise (apply-per-push, the
         reference's staleness-mode ConditionalAccumulator semantics,
         ps_synchronizer.py:556-633 with num_required=1). Returns the
-        tensor's total push count."""
+        tensor's total push count. Addition commutes, so chunked pushes
+        from concurrent workers interleave exactly."""
         wire = _wire_dtype(wire)
-        payload = _encode(delta, wire)
-        resp = self._rpc('BADD %s %d %s' % (key, len(payload), wire),
-                         payload)
-        if not resp.startswith('VAL'):
-            raise OSError('BADD %s failed: %s' % (key, resp))
-        return int(resp[4:])
+        flat = np.ascontiguousarray(
+            np.asarray(delta, dtype=np.float32)).reshape(-1)
+        ranges = self._ranges(flat.size, wire)
+        pushes = 0
+        for off, count in ranges:
+            payload = _encode(flat[off:off + count], wire)
+            suffix = '' if len(ranges) == 1 else \
+                ' %d %d' % (off, flat.size)
+            resp = self._rpc('BADD %s %d %s%s'
+                             % (key, len(payload), wire, suffix), payload)
+            if not resp.startswith('VAL'):
+                raise OSError('BADD %s failed: %s' % (key, resp))
+            pushes = int(resp[4:])
+        return pushes
 
-    def vstep(self, key, grad, lr, momentum=0.0, wire=None):
-        """Push a raw GRADIENT; the service applies the SGD/momentum
-        update with a PS-resident velocity slot shared by all workers
-        (the reference's PS-resident optimizer, partitioner.py:570-573 /
-        ps_synchronizer.py:175-176). Returns the push count."""
+    def vstep(self, key, grad, rule, params, wire=None):
+        """Push a raw GRADIENT; the service applies the named update
+        rule with PS-resident slots shared by all workers (the
+        reference re-creates the user's optimizer over PS-resident
+        variables, partitioner.py:570-573 / ps_synchronizer.py:175-176).
+
+        ``rule`` is one of ``sgd`` (params [lr, momentum]), ``adam``
+        ([lr, b1, b2, eps]), ``adagrad`` ([lr, eps, init_acc]). Returns
+        the shared step index used (the adam bias-correction t). Chunked
+        pushes share one t: the offset-0 chunk draws it, later chunks
+        pass it explicitly — every rule is elementwise in (w, slots), so
+        ranged application is exact."""
         wire = _wire_dtype(wire)
-        payload = _encode(grad, wire)
-        resp = self._rpc('BSTEP %s %d %s %.17g %.17g'
-                         % (key, len(payload), wire, lr, momentum),
-                         payload)
+        flat = np.ascontiguousarray(
+            np.asarray(grad, dtype=np.float32)).reshape(-1)
+        p = (list(params) + [0.0] * 4)[:4]
+        ranges = self._ranges(flat.size, wire)
+        step = 0
+        for off, count in ranges:
+            payload = _encode(flat[off:off + count], wire)
+            suffix = '' if len(ranges) == 1 else \
+                ' %d %d' % (off, flat.size)
+            resp = self._rpc(
+                'BSTEP %s %d %s %s %d %.17g %.17g %.17g %.17g%s'
+                % (key, len(payload), wire, rule, step,
+                   p[0], p[1], p[2], p[3], suffix), payload)
+            if not resp.startswith('VAL'):
+                raise OSError('BSTEP %s failed: %s' % (key, resp))
+            step = int(resp[4:])
+        return step
+
+    def vstat(self, key):
+        """Tensor introspection: ``{'pushes', 'steps', 'elems',
+        'slot1', 'slot2'}`` or None if absent — verifies PS-resident
+        optimizer state (e.g. shared adam: steps == total pushes)."""
+        resp = self._rpc('BSTAT %s' % key)
+        if resp == 'NONE':
+            return None
         if not resp.startswith('VAL'):
-            raise OSError('BSTEP %s failed: %s' % (key, resp))
+            raise OSError('BSTAT %s failed: %s' % (key, resp))
+        p, s, n, s1, s2 = resp[4:].split()
+        return {'pushes': int(p), 'steps': int(s), 'elems': int(n),
+                'slot1': bool(int(s1)), 'slot2': bool(int(s2))}
+
+    def delete_namespace(self, prefix):
+        """Purge every key/counter/tensor/barrier under ``prefix`` —
+        run-end cleanup so a long-lived endpoint daemon does not
+        accumulate dead runs' tensors. Returns the entry count purged."""
+        resp = self._rpc('DELNS %s' % prefix)
+        if not resp.startswith('VAL'):
+            raise OSError('DELNS %s failed: %s' % (prefix, resp))
         return int(resp[4:])
 
     def wait_key(self, key, timeout_s=60.0, poll_s=0.05):

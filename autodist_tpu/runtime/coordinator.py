@@ -26,7 +26,12 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_COORD_SERVICE_ADDR,
                     ENV.AUTODIST_HEARTBEAT_TIMEOUT,
                     ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
+                    ENV.AUTODIST_PS_CHUNK_BYTES,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
+# AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
+# assignments ride the remote ssh command line, which is world-readable
+# in `ps` on the worker host. The secret ships as a mode-0600 file
+# instead (_copy_token), referenced via AUTODIST_COORD_TOKEN_FILE.
 
 
 class Coordinator:
@@ -39,6 +44,7 @@ class Coordinator:
         self._shutting_down = False
         self.threads = []
         self.procs = []
+        self._token_path = ''
 
     def _worker_env(self, worker_addr, process_id):
         env = {
@@ -61,6 +67,8 @@ class Coordinator:
             raw = os.environ.get(flag.name)
             if raw:
                 env[flag.name] = raw
+        if self._token_path:
+            env[ENV.AUTODIST_COORD_TOKEN_FILE.name] = self._token_path
         return env
 
     def _ssh_base(self, ssh_config, scp=False):
@@ -98,6 +106,36 @@ class Coordinator:
         subprocess.run(scp_cmd, check=True)
         subprocess.run(mv_cmd, check=True)
 
+    def _copy_token(self, address, ssh_config):
+        """Ship the coord-service shared secret to a worker host as a
+        mode-0600 file (env assignments ride the remote command line —
+        world-readable in `ps` — so the secret goes by file, like the
+        reference rode authenticated scp for everything it shipped)."""
+        from autodist_tpu.runtime.coord_client import coord_token
+        token = coord_token()
+        if not token:
+            self._token_path = ''
+            return
+        path = os.path.join(os.path.dirname(self._strategy.path),
+                            'coord_token')
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w') as f:
+            f.write(token)
+        self._token_path = path
+        tmp = '%s.ship.%d' % (path, os.getpid())
+        target = self._target(address, ssh_config)
+        scp_cmd = self._ssh_base(ssh_config, scp=True) + \
+            [path, '%s:%s' % (target, tmp)]
+        mv_cmd = self._ssh_base(ssh_config) + \
+            [target, 'chmod 600 %s && mv -f %s %s' %
+             (shlex.quote(tmp), shlex.quote(tmp), shlex.quote(path))]
+        if ENV.AUTODIST_DEBUG_REMOTE.val:
+            logging.info('[debug-remote] %s', ' '.join(scp_cmd))
+            logging.info('[debug-remote] %s', ' '.join(mv_cmd))
+            return
+        subprocess.run(scp_cmd, check=True)
+        subprocess.run(mv_cmd, check=True)
+
     def launch_clients(self):
         """Re-run ``sys.argv`` on every non-chief replica host."""
         chief = self._resource_spec.chief
@@ -107,6 +145,7 @@ class Coordinator:
         for i, address in enumerate(workers, start=1):
             ssh_config = self._resource_spec.ssh_config(address)
             self._copy_strategy(address, ssh_config)
+            self._copy_token(address, ssh_config)
             env = self._worker_env(address, i)
             env_str = ' '.join('%s=%s' % (k, shlex.quote(v))
                                for k, v in env.items())

@@ -48,38 +48,53 @@ class RunOptions:
 
 
 def assign_ps_endpoints(var_plans, endpoints):
-    """Map each variable to a PS endpoint index.
+    """Map each variable to PS endpoint indices, one PER SHARD.
 
-    Placement honors the strategy's ``reduction_destination``
-    (reference ps_lb_strategy.py:64-83 bin-packing): endpoints
-    co-located on the destination's host are preferred (several on one
-    host spread by destination ordinal); destinations on unknown hosts
-    map by their ordinal among the sorted distinct destinations; vars
-    without a destination hash stably. Pure function so placement is
+    Placement honors the strategy's per-shard ``reduction_destination``s
+    (reference ps_lb_strategy.py:64-83 bin-packing;
+    partitioned_ps_strategy.py:89-96 places each shard of a partitioned
+    variable on its own PS — ``part_config`` is consumed here, not just
+    ``syncs[0]``): endpoints co-located on the destination's host are
+    preferred (several on one host spread by destination ordinal);
+    destinations on unknown hosts map by their ordinal among the sorted
+    distinct destinations; vars without a destination hash stably.
+    Returns ``{var name: [endpoint idx per shard]}`` (a 1-element list
+    for unpartitioned variables). Pure function so placement is
     unit-testable and deterministic across processes.
     """
     import zlib
     n = len(endpoints)
     hosts = [h for h, _ in endpoints]
-    dests = sorted({
-        getattr(p.sync, 'reduction_destination', '')
-        for p in var_plans.values()
-        if p.is_ps and getattr(p.sync, 'reduction_destination', '')})
-    dest_ord = {d: i for i, d in enumerate(dests)}
-    out = {}
-    for name, p in var_plans.items():
-        dest = getattr(p.sync, 'reduction_destination', '') \
-            if p.is_ps else ''
+    all_dests = set()
+    for p in var_plans.values():
+        if not p.is_ps:
+            continue
+        for s in getattr(p, 'all_syncs', [p.sync]):
+            d = getattr(s, 'reduction_destination', '')
+            if d:
+                all_dests.add(d)
+    dest_ord = {d: i for i, d in enumerate(sorted(all_dests))}
+
+    def resolve(label, sync, is_ps):
+        dest = getattr(sync, 'reduction_destination', '') if is_ps else ''
         if dest:
             dhost = dest.split(':', 1)[0]
             cands = [i for i, h in enumerate(hosts) if h == dhost]
             if cands:
-                idx = cands[dest_ord[dest] % len(cands)]
-            else:
-                idx = dest_ord[dest] % n
+                return cands[dest_ord[dest] % len(cands)]
+            return dest_ord[dest] % n
+        return zlib.crc32(label.encode()) % n
+
+    out = {}
+    for name, p in var_plans.items():
+        syncs = list(getattr(p, 'all_syncs', [p.sync]))
+        nshards = getattr(p, 'num_shards', 1)
+        if nshards > 1 and len(syncs) == nshards:
+            out[name] = [
+                resolve('%s/shard%d' % (name, i), s, p.is_ps)
+                for i, s in enumerate(syncs)]
         else:
-            idx = zlib.crc32(name.encode()) % n
-        out[name] = idx
+            out[name] = [resolve(name, p.sync, p.is_ps)]
     return out
 
 
@@ -143,6 +158,7 @@ class Session:
         self._ps_clients = []
         self._ps_index = {}
         self._ps_bytes = 0
+        self._ps_ep_bytes = []
         self._ps_seconds = 0.0
         if self._loose:
             self._init_ps_endpoints()
@@ -329,9 +345,11 @@ class Session:
                 ('127.0.0.1' if is_local_address(host) else host, port))
             for host, port in eps]
         self._ps_index = assign_ps_endpoints(self._plan.var_plans, eps)
-        counts = [sum(1 for i in self._ps_index.values() if i == k)
-                  for k in range(len(eps))]
-        logging.info('PS data plane: %d endpoints, variables per '
+        counts = [0] * len(eps)
+        for idxs in self._ps_index.values():
+            for i in idxs:
+                counts[i] += 1
+        logging.info('PS data plane: %d endpoints, variable shards per '
                      'endpoint %s', len(eps), counts)
 
     @staticmethod
@@ -339,46 +357,70 @@ class Session:
         import zlib
         return zlib.crc32(name.encode()) % n
 
-    def _ps_client_for(self, name):
-        idx = self._ps_index.get(name)
-        if idx is None:
-            idx = self._stable_idx(name, len(self._ps_clients))
-            self._ps_index[name] = idx
-        return self._ps_clients[idx]
+    def _shard_info(self, name):
+        """Loose-mode transfer geometry for a variable: its
+        :class:`PartitionerConfig` (None when unpartitioned) and the
+        per-shard key suffixes. Partitioned variables live as one
+        tensor PER SHARD on the data plane (``var/<name>/shard<i>``) so
+        each shard lands on the endpoint its ``part_config`` destination
+        names (reference partitioned_ps_strategy.py:89-96 + per-shard
+        variables, kernel/partitioner.py:153-173)."""
+        p = self._plan.var_plans.get(name)
+        nshards = getattr(p, 'num_shards', 1) if p is not None else 1
+        if nshards > 1:
+            return (p.part_config,
+                    ['var/%s/shard%d' % (name, i) for i in range(nshards)])
+        return None, ['var/%s' % name]
+
+    def _shard_endpoints(self, name, nshards):
+        """Endpoint index per shard (extended if the strategy named
+        fewer destinations than shards)."""
+        idxs = self._ps_index.get(name)
+        if idxs is None:
+            idxs = [self._stable_idx(name, len(self._ps_clients))]
+            self._ps_index[name] = idxs
+        if len(idxs) < nshards:
+            idxs = [idxs[i % len(idxs)] for i in range(nshards)]
+        return idxs
 
     def _ps_transfer(self, names, fn):
-        """Run ``fn(client, name)`` for every name; names grouped by
+        """Run ``fn(client, key_suffix, name, shard_i, part_config)``
+        for every (variable, shard) transfer unit; units grouped by
         endpoint, endpoint groups in parallel threads. Each endpoint's
         socket is used by exactly one thread (CoordClient sockets are
         not thread-safe), so multi-endpoint pulls/pushes overlap across
-        PS servers like the reference's concurrent grpc channels."""
+        PS servers like the reference's concurrent grpc channels.
+        Returns ``{name: [per-shard result]}``."""
         groups = {}
+        shard_counts = {}
         for name in names:
-            self._ps_client_for(name)
-            groups.setdefault(self._ps_index[name], []).append(name)
-        results = {}
+            pc, keys = self._shard_info(name)
+            idxs = self._shard_endpoints(name, len(keys))
+            shard_counts[name] = len(keys)
+            for i, (key, ep) in enumerate(zip(keys, idxs)):
+                groups.setdefault(ep, []).append((key, name, i, pc))
+        results = {name: [None] * c for name, c in shard_counts.items()}
+
+        def run_group(ep, units):
+            client = self._ps_clients[ep]
+            for key, name, i, pc in units:
+                results[name][i] = fn(client, key, name, i, pc)
+
         if len(groups) <= 1:
-            for idx, grp in groups.items():
-                client = self._ps_clients[idx]
-                for name in grp:
-                    results[name] = fn(client, name)
+            for ep, units in groups.items():
+                run_group(ep, units)
             return results
         import threading
-        lock = threading.Lock()
         errs = []
 
-        def work(idx, grp):
-            client = self._ps_clients[idx]
+        def work(ep, units):
             try:
-                for name in grp:
-                    r = fn(client, name)
-                    with lock:
-                        results[name] = r
+                run_group(ep, units)
             except Exception as e:  # noqa: BLE001 - re-raised below
                 errs.append(e)
 
-        threads = [threading.Thread(target=work, args=(i, g))
-                   for i, g in groups.items()]
+        threads = [threading.Thread(target=work, args=(ep, units))
+                   for ep, units in groups.items()]
         for t in threads:
             t.start()
         for t in threads:
@@ -387,11 +429,29 @@ class Session:
             raise errs[0]
         return results
 
+    def _account_ep_bytes(self, name):
+        """Attribute one whole-tensor transfer's wire bytes to the
+        endpoints its shards live on (per-endpoint load accounting)."""
+        if not self._ps_ep_bytes:
+            self._ps_ep_bytes = [0] * len(self._ps_clients)
+        var = self._graph_item.var_by_name(name)
+        pc, keys = self._shard_info(name)
+        idxs = self._shard_endpoints(name, len(keys))
+        if pc is None:
+            sizes = [int(np.prod(var.shape)) if var.shape else 1]
+        else:
+            sizes = [int(np.prod(s)) for s in
+                     pc.shard_shapes(var.shape)]
+        for ep, n in zip(idxs, sizes):
+            self._ps_ep_bytes[ep] += self._wire_nbytes(n)
+
     @property
     def ps_stats(self):
         """Loose-mode wire accounting: payload bytes moved and seconds
-        spent on PS pulls+pushes (the measured per-step PS overhead)."""
+        spent on PS pulls+pushes (the measured per-step PS overhead),
+        plus the per-endpoint byte split (balanced placement evidence)."""
         return {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
+                'bytes_per_endpoint': list(self._ps_ep_bytes),
                 'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
                              if self._ps_seconds else 0.0)}
 
@@ -447,25 +507,33 @@ class Session:
                     np.asarray(v)
         if self._loose:
             variables = self._graph_item.graph.variables
-            # chief seeds the authoritative PS copies across endpoints
+
+            def seed(c, key, name, shard, pc):
+                val = np.asarray(variables[name].init_value)
+                if pc is not None:
+                    val = pc.split(val)[shard]
+                c.vset(self._key(key), val)
+
+            def fetch(c, key, name, shard, pc):
+                shp = variables[name].shape if pc is None else \
+                    pc.shard_shapes(variables[name].shape)[shard]
+                return c.vget(self._key(key), shape=shp)
+
+            # chief seeds the authoritative PS copies across endpoints,
+            # one tensor per shard for partitioned variables
             if self._is_chief:
-                self._ps_transfer(
-                    list(variables),
-                    lambda c, name: c.vset(
-                        self._key('var/%s' % name),
-                        np.asarray(variables[name].init_value)))
+                self._ps_transfer(list(variables), seed)
             # heartbeat baseline BEFORE the barrier: once any gate runs,
             # every peer has a timestamp (a missing one reads as dead)
             self._coord.heartbeat(self._key(self._worker_name))
             self._coord.barrier(self._key('session/init'),
                                 self._num_workers, timeout_s=120.0)
             if not self._is_chief:
-                served_map = self._ps_transfer(
-                    list(variables),
-                    lambda c, name: c.vget(self._key('var/%s' % name),
-                                           shape=variables[name].shape))
-                for name, served in served_map.items():
+                served_map = self._ps_transfer(list(variables), fetch)
+                for name, parts in served_map.items():
                     var = variables[name]
+                    pc, _ = self._shard_info(name)
+                    served = parts[0] if pc is None else pc.merge(parts)
                     var.init_value = served.astype(var.init_value.dtype)
         self._var_state = {}
         for name, var in self._graph_item.graph.variables.items():
@@ -627,10 +695,10 @@ class Session:
             self._step_count += 1
             if self._loose:
                 shared_push = {}
-                for name, idx, lr, mom in shared_spec:
+                for name, idx, rule, params in shared_spec:
                     g = self._local_stack(outs[idx])[0]
                     shared_push[name] = (np.asarray(g, np.float32),
-                                         lr, mom)
+                                         rule, params)
                 self._push_ps_deltas(pulled, shared_push)
                 self._coord.publish_step(self._worker_name,
                                          self._step_count,
@@ -649,7 +717,8 @@ class Session:
 
     def _pull_ps_vars(self):
         """Refresh variable state from the authoritative PS copies (the
-        worker's per-step PS read), endpoints pulled in parallel.
+        worker's per-step PS read), endpoints pulled in parallel; each
+        shard of a partitioned variable comes from its own endpoint.
         Returns the pulled host values for delta computation."""
         import time as _time
         t0 = _time.perf_counter()
@@ -657,16 +726,24 @@ class Session:
         to_fetch = [name for name in variables
                     if not (name in self._proxy_vars and
                             name in self._proxy_cache)]
-        fetched = self._ps_transfer(
-            to_fetch,
-            lambda c, name: c.vget(self._key('var/%s' % name),
-                                   shape=variables[name].shape))
+
+        def fetch(c, key, name, shard, pc):
+            shp = variables[name].shape if pc is None else \
+                pc.shard_shapes(variables[name].shape)[shard]
+            return c.vget(self._key(key), shape=shp)
+
+        fetched = self._ps_transfer(to_fetch, fetch)
         pulled = {}
         n_elems = 0
         for name, var in variables.items():
             if name in fetched:
-                served = fetched[name]
+                parts = fetched[name]
+                pc, _ = self._shard_info(name)
+                served = parts[0] if pc is None else (
+                    None if any(p is None for p in parts)
+                    else pc.merge(parts))
                 n_elems += int(np.prod(var.shape)) if var.shape else 1
+                self._account_ep_bytes(name)
                 if served is None:  # pragma: no cover - init barrier
                     served = np.asarray(var.init_value, dtype=np.float32)
                 served = served.astype(var.init_value.dtype)
@@ -685,10 +762,10 @@ class Session:
 
     def _shared_push_spec(self, norm):
         """Plan the PS-side optimizer pushes for the fetched train ops:
-        returns ``[(var_name, fetch_idx, lr, momentum)]`` plus the extra
+        returns ``[(var_name, fetch_idx, rule, params)]`` plus the extra
         (synced) gradient nodes to fetch. Optimizers without scalar
-        ``ps_step_params`` (non-SGD-family) fall back to worker-local
-        slots with a one-time note."""
+        ``ps_step_params`` (schedule-driven or exotic rules) fall back
+        to worker-local slots with a one-time note."""
         spec = []
         extra = []
         node_pos = {id(f): i for i, f in enumerate(norm)}
@@ -704,17 +781,18 @@ class Session:
                         self._shared_warned.add(var.name)
                         logging.warning(
                             'shared_optimizer requested for %s but '
-                            'optimizer %s has no PS-side step (SGD '
-                            'family only); its slots stay worker-local',
-                            var.name, f.optimizer.name)
+                            'optimizer %s has no PS-side update rule '
+                            '(sgd/momentum/adam/adagrad with scalar '
+                            'hyperparameters); its slots stay '
+                            'worker-local', var.name, f.optimizer.name)
                     continue
                 idx = node_pos.get(id(gnode))
                 if idx is None:
                     idx = len(norm) + len(extra)
                     node_pos[id(gnode)] = idx
                     extra.append(gnode)
-                spec.append((var.name, idx, params['lr'],
-                             params['momentum']))
+                spec.append((var.name, idx, params['rule'],
+                             params['params']))
         return spec, extra
 
     def _push_ps_deltas(self, pulled, shared_push=None):
@@ -723,8 +801,10 @@ class Session:
         accumulate exactly like the reference's apply-per-push
         accumulators. Vars in ``shared_push`` instead ship their raw
         gradient; the service applies the optimizer step with
-        PS-resident shared slots (BSTEP). Endpoint groups push in
-        parallel."""
+        PS-resident shared slots (BSTEP). Partitioned variables push
+        each shard's slice to that shard's own endpoint (the reference
+        splits gradients per shard, kernel/partitioner.py:686-704).
+        Endpoint groups push in parallel."""
         import time as _time
         t0 = _time.perf_counter()
         shared_push = shared_push or {}
@@ -732,27 +812,40 @@ class Session:
                                    dtype=np.float32)
                   for name in pulled if name not in shared_push}
 
-        def push(client, name):
+        def push(client, key, name, shard, pc):
             if name in shared_push:
-                g, lr, mom = shared_push[name]
-                client.vstep(self._key('var/%s' % name), g, lr, mom)
+                g, rule, params = shared_push[name]
+                if pc is not None:
+                    g = pc.split(g)[shard]
+                client.vstep(self._key(key), g, rule, params)
             else:
                 delta = afters[name] - np.asarray(pulled[name],
                                                   dtype=np.float32)
-                client.vadd(self._key('var/%s' % name), delta)
+                if pc is not None:
+                    delta = pc.split(delta)[shard]
+                client.vadd(self._key(key), delta)
 
         self._ps_transfer(list(pulled), push)
+        for name in pulled:
+            self._account_ep_bytes(name)
         self._shared_pushes += sum(1 for n in pulled if n in shared_push)
         n_elems = sum(a.size for a in afters.values()) + \
             sum(g.size for g, _, _ in shared_push.values())
+
+        def refetch(client, key, name, shard, pc):
+            shp = self._graph_item.var_by_name(name).shape
+            if pc is not None:
+                shp = pc.shard_shapes(shp)[shard]
+            return client.vget(self._key(key), shape=shp)
+
         # post-update assign (proxy_variable.py:163-190): refresh the
         # proxy from the PS after the push, off the pre-step path
-        refreshed = self._ps_transfer(
-            list(self._proxy_vars),
-            lambda c, name: c.vget(
-                self._key('var/%s' % name),
-                shape=self._graph_item.var_by_name(name).shape))
-        for name, served in refreshed.items():
+        refreshed = self._ps_transfer(list(self._proxy_vars), refetch)
+        for name, parts in refreshed.items():
+            pc, _ = self._shard_info(name)
+            served = parts[0] if pc is None else (
+                None if any(p is None for p in parts)
+                else pc.merge(parts))
             if served is not None:
                 var = self._graph_item.var_by_name(name)
                 self._proxy_cache[name] = \
@@ -868,6 +961,25 @@ class Session:
                     'done/%s' % self._key(self._worker_name), '1')
                 self._coord.publish_step(self._worker_name, 1 << 30,
                                          prefix=self._key('step/'))
+                # run-end cleanup (ADVICE r3): the LAST worker out
+                # purges the run's namespace from the coord service and
+                # every PS endpoint — a reused long-lived endpoint must
+                # not accumulate dead runs' multi-hundred-MB tensors.
+                # The atomic INCR makes exactly one process the purger,
+                # and only after every peer has closed.
+                closed = self._coord.incr(self._key('closed'), 1)
+                if closed >= self._num_workers:
+                    purged = 0
+                    clients = list(self._ps_clients)
+                    if self._coord not in clients:
+                        clients.append(self._coord)
+                    for client in clients:
+                        purged += client.delete_namespace(self._ns + '/')
+                    for prefix in ('hb/%s/' % self._ns,
+                                   'done/%s/' % self._ns):
+                        self._coord.delete_namespace(prefix)
+                    logging.debug('purged %d namespace entries for run '
+                                  '%s', purged, self._ns)
             except Exception:  # noqa: BLE001 - service may be gone
                 pass
         self._closed = True
@@ -908,10 +1020,18 @@ class Session:
     def get_variable_value(self, var):
         name = var.name if isinstance(var, fe.Variable) else var
         if self._loose:
-            # authoritative copy lives on the variable's PS endpoint
+            # authoritative copy lives on the variable's PS endpoint(s):
+            # each shard of a partitioned variable on its own endpoint
             var_obj = self._graph_item.var_by_name(name)
-            served = self._ps_client_for(name).vget(
-                self._key('var/%s' % name), shape=var_obj.shape)
+
+            def fetch(c, key, _name, shard, pc):
+                shp = var_obj.shape if pc is None else \
+                    pc.shard_shapes(var_obj.shape)[shard]
+                return c.vget(self._key(key), shape=shp)
+
+            parts = self._ps_transfer([name], fetch)[name]
+            pc, _ = self._shard_info(name)
+            served = parts[0] if pc is None else pc.merge(parts)
             return served.astype(var_obj.init_value.dtype)
         return self._local_value(name)
 
@@ -921,5 +1041,10 @@ class Session:
             self._plan.pad_host(name, jnp.asarray(value)),
             self._plan.var_sharding(name))
         if self._loose and self._is_chief:
-            self._ps_client_for(name).vset(self._key('var/%s' % name),
-                                           np.asarray(value))
+            def store(c, key, _name, shard, pc):
+                val = np.asarray(value)
+                if pc is not None:
+                    val = pc.split(val)[shard]
+                c.vset(self._key(key), val)
+
+            self._ps_transfer([name], store)

@@ -1,7 +1,8 @@
 """PS endpoint placement (loose-mode data plane): the pure mapping
-function that makes PSLoadBalancing's bin-packing load-bearing at
-runtime (reference ps_lb_strategy.py:64-83 + one server per PS node,
-utils/server_starter.py:48-75)."""
+function that makes PSLoadBalancing's bin-packing — and PartitionedPS's
+per-shard round-robin placement (reference
+partitioned_ps_strategy.py:89-96) — load-bearing at runtime, with one
+coord-service endpoint per PS node (utils/server_starter.py:48-75)."""
 import numpy as np
 import pytest
 
@@ -12,8 +13,10 @@ from autodist_tpu.strategy.base import (AllReduceSynchronizer,
 
 
 class _Plan:
-    def __init__(self, sync):
+    def __init__(self, sync, all_syncs=None, num_shards=1):
         self.sync = sync
+        self.all_syncs = all_syncs or [sync]
+        self.num_shards = num_shards
         self.is_ps = isinstance(sync, PSSynchronizer)
 
 
@@ -21,11 +24,16 @@ def _ps(dest):
     return _Plan(PSSynchronizer(reduction_destination=dest))
 
 
+def _sharded(dests):
+    syncs = [PSSynchronizer(reduction_destination=d) for d in dests]
+    return _Plan(syncs[0], all_syncs=syncs, num_shards=len(syncs))
+
+
 def test_host_match_places_on_colocated_endpoint():
     plans = {'a': _ps('10.0.0.1:CPU:0'), 'b': _ps('10.0.0.2:CPU:0')}
     idx = assign_ps_endpoints(plans, [('10.0.0.1', 9000),
                                       ('10.0.0.2', 9000)])
-    assert idx == {'a': 0, 'b': 1}
+    assert idx == {'a': [0], 'b': [1]}
 
 
 def test_colocated_endpoints_spread_by_destination():
@@ -34,7 +42,7 @@ def test_colocated_endpoints_spread_by_destination():
     plans = {'a': _ps('10.0.0.5:CPU:0'), 'b': _ps('10.0.0.5:CPU:1')}
     idx = assign_ps_endpoints(plans, [('10.0.0.5', 9000),
                                       ('10.0.0.5', 9001)])
-    assert sorted(idx.values()) == [0, 1]
+    assert sorted(i for v in idx.values() for i in v) == [0, 1]
 
 
 def test_unknown_host_maps_by_destination_ordinal():
@@ -52,7 +60,7 @@ def test_no_destination_hashes_stably():
     idx1 = assign_ps_endpoints(plans, eps)
     idx2 = assign_ps_endpoints(plans, eps)
     assert idx1 == idx2                       # deterministic
-    assert len(set(idx1.values())) > 1        # actually spreads
+    assert len({i for v in idx1.values() for i in v}) > 1  # spreads
 
 
 def test_mapping_identical_across_orderings():
@@ -62,6 +70,35 @@ def test_mapping_identical_across_orderings():
     b = dict(reversed(list(a.items())))
     eps = [('n1', 1), ('n2', 1)]
     assert assign_ps_endpoints(a, eps) == assign_ps_endpoints(b, eps)
+
+
+def test_partitioned_var_spreads_shards_across_endpoints():
+    """PartitionedPS's per-shard destinations are consumed: each shard
+    of ONE variable lands on its own endpoint (reference
+    partitioned_ps_strategy.py:89-96 — the whole point of partitioning
+    a 400 MB embedding is that its shards do NOT share a socket)."""
+    plans = {'emb': _sharded(['n1:CPU:0', 'n2:CPU:0']),
+             'w': _ps('n1:CPU:0')}
+    idx = assign_ps_endpoints(plans, [('n1', 9000), ('n2', 9000)])
+    assert idx['emb'] == [0, 1]
+    assert idx['w'] == [0]
+
+
+def test_partitioned_var_round_robin_on_unknown_hosts():
+    plans = {'emb': _sharded(['a:CPU:0', 'b:CPU:0', 'a:CPU:0'])}
+    idx = assign_ps_endpoints(plans, [('h', 1), ('h', 2)])
+    assert len(idx['emb']) == 3
+    # same destination -> same endpoint; distinct destinations spread
+    assert idx['emb'][0] == idx['emb'][2] != idx['emb'][1]
+
+
+def test_shard_count_mismatch_falls_back_to_primary():
+    """A partitioned var whose strategy carried a single synchronizer
+    (no per-shard part_config) maps as one unit."""
+    p = _Plan(PSSynchronizer(reduction_destination='n1:CPU:0'),
+              num_shards=4)
+    idx = assign_ps_endpoints({'v': p}, [('n1', 1), ('n2', 1)])
+    assert idx['v'] == [0]
 
 
 def test_ps_endpoints_env_parsing(monkeypatch):
