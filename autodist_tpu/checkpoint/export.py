@@ -36,15 +36,21 @@ from autodist_tpu.utils import logging
 _FORMAT = 'autodist_tpu.saved_model.v1'
 
 
-def _input_spec(shape, dtype, scope, sym_names):
+def _input_spec(shape, dtype, scope, sym_names, input_idx,
+                shared_batch_dim):
     """ShapeDtypeStruct for one input; ``None`` dims become symbolic
     (shared scope, so one symbol name = one dimension variable)."""
     dims = []
     for i, d in enumerate(tuple(shape or ())):
         if d is None:
-            # leading dim shares the batch symbol; later unknown dims
-            # each get their own
-            name = 'b' if i == 0 else 'd%d' % len(sym_names)
+            # With shared_batch_dim every leading None dim is the SAME
+            # symbol 'b' (inputs of one batch must agree at call time);
+            # without it each input's leading dim is independent
+            # ('b<input index>'). Later unknown dims each get their own.
+            if i == 0:
+                name = 'b' if shared_batch_dim else 'b%d' % input_idx
+            else:
+                name = 'd%d' % len(sym_names)
             sym_names.add(name)
             dims.append(jax_export.symbolic_shape(name, scope=scope)[0])
         else:
@@ -55,7 +61,7 @@ def _input_spec(shape, dtype, scope, sym_names):
 def export_servable(fn, params, input_shapes, path,
                     signature='serving_default', tags=('serve',),
                     platforms=('cpu', 'tpu'), input_names=None,
-                    write_params=True):
+                    write_params=True, shared_batch_dim=True):
     """Export ``fn(params, *inputs) -> list of outputs`` as a servable
     bundle.
 
@@ -67,11 +73,17 @@ def export_servable(fn, params, input_shapes, path,
         signature: name of this entrypoint.
         platforms: lowering targets baked into the artifact.
         input_names: optional names recorded in the metadata.
+        shared_batch_dim: True (default) asserts every input's leading
+            ``None`` dim is the SAME batch dimension (they must agree at
+            call time — the usual one-batch signature). Pass False for
+            signatures whose inputs carry genuinely independent dynamic
+            leading dims (each gets its own symbol).
     """
     os.makedirs(path, exist_ok=True)
     scope = jax_export.SymbolicScope()
     sym_names = set()
-    specs = [_input_spec(s, d, scope, sym_names) for s, d in input_shapes]
+    specs = [_input_spec(s, d, scope, sym_names, i, shared_batch_dim)
+             for i, (s, d) in enumerate(input_shapes)]
     host_params = jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
                                params)
     param_specs = jax.tree.map(
@@ -104,6 +116,7 @@ def export_servable(fn, params, input_shapes, path,
                    for i, spec in enumerate(specs)],
         'call_convention':
             'module.call(flat_params_dict, *inputs) -> flat outputs',
+        'shared_batch_dim': bool(shared_batch_dim),
     }
     with open(meta_path, 'w') as f:
         json.dump(meta, f, indent=1, sort_keys=True)

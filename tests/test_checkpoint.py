@@ -290,6 +290,36 @@ def test_export_servable_roundtrip_and_multi_signature(tmp_path):
     assert set(meta['signatures']) == {'serving_default', 'tanh'}
 
 
+def test_export_independent_batch_dims(tmp_path):
+    """shared_batch_dim=False: two inputs with genuinely independent
+    dynamic leading dims export correctly and serve with DIFFERENT
+    batch sizes per input (ADVICE r3: a single shared 'b' symbol forced
+    them equal)."""
+    from autodist_tpu.checkpoint.export import (export_servable,
+                                                load_servable)
+    rng = np.random.RandomState(2)
+    params = {'w': rng.randn(4, 3).astype(np.float32)}
+
+    def fn(p, queries, keys):
+        # (Q, 3) x (K, 3) -> (Q, K) similarity: Q and K are unrelated
+        return [(queries @ p['w']) @ (keys @ p['w']).T]
+
+    path = str(tmp_path / 'bundle_ind')
+    export_servable(fn, params,
+                    [((None, 4), np.float32), ((None, 4), np.float32)],
+                    path, shared_batch_dim=False)
+    q = rng.randn(5, 4).astype(np.float32)
+    k = rng.randn(9, 4).astype(np.float32)   # different leading dim
+    serve = load_servable(path)
+    out = np.asarray(serve(q, k)[0])
+    want = (q @ params['w']) @ (k @ params['w']).T
+    np.testing.assert_allclose(out, want, atol=1e-5)
+    import json as _json
+    meta = _json.load(open(os.path.join(path, 'saved_model.json')))
+    assert meta['signatures']['serving_default'][
+        'shared_batch_dim'] is False
+
+
 def test_functional_state_roundtrip_across_meshes(tmp_path):
     """Trainer state saved on a tp=2 mesh restores onto a dp mesh."""
     cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=2)
