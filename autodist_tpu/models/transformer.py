@@ -269,11 +269,14 @@ class TransformerLM(Module):
         return nll, aux
 
     def _loss_1f1b(self, params, batch, pipe_axis):
-        """Pipelined loss with the head folded into the pipeline's last
-        stage (1F1B): targets stream alongside activations and the
-        pipeline emits per-token NLL ``[mb, seq]`` per microbatch, so
-        neither a full-batch ``[B, s, dim]`` activation stack nor a
-        full-batch ``[B, s, vocab]`` logits slab ever materializes.
+        """Pipelined loss via the FUSED 1F1B schedule: the embedding
+        folds into the first stage (``head_fn``) and the lm-head + NLL
+        into the last (``tail_fn``), so the pipeline's interface is
+        token-sized — no full-batch ``[B, s, dim]`` activation stack,
+        ``[B, s, vocab]`` logits slab, or input cotangent ever
+        materializes, and the custom-vjp backward bounds each rank's
+        live activations at ``2(pp-1)+1`` microbatches (true 1F1B
+        working set, independent of the microbatch count).
         ``loss_chunk`` is subsumed — each microbatch IS a head chunk."""
         cfg = self.cfg
         if not cfg.scan_layers:
@@ -281,19 +284,29 @@ class TransformerLM(Module):
                 'pipeline parallelism requires scan_layers=True '
                 '(blocks must be stage-stacked to shard over pipe)')
         from autodist_tpu.parallel.pipeline import one_f_one_b
-        x = self._embedded(params, batch['tokens'])
 
-        # checkpointed like the chunked-CE scan: backward recomputes each
-        # microbatch's [mb, s, vocab] logits instead of saving one per
-        # schedule step (which would re-materialize the full-batch slab)
-        @jax.checkpoint
-        def tail(h, tgt):
-            h = self.ln_f.apply(params['ln_f'], h)
-            return self._chunk_nll(params, h, tgt)
+        def head(p, tok_mb):
+            return self._embedded(p, tok_mb)
 
-        return one_f_one_b(self._block_fn(), params['blocks'], x,
-                           pipe_axis, ctx_option('microbatches', 1),
-                           tail_fn=tail, extra=batch['targets'])
+        def tail(p, h, tgt):
+            h = self.ln_f.apply(p['ln_f'], h)
+            return self._chunk_nll(p, h, tgt)
+
+        # Pass ONLY the subtrees head/tail actually touch: the fused
+        # backward carries + psums a zeros-like of these trees, so
+        # handing it the full params dict would add two block-stack-
+        # sized gradient buffers for nothing.
+        head_params = {k: params[k] for k in ('embed', 'pos_embed')}
+        tail_params = {
+            k: params[k]
+            for k in ('ln_f',
+                      'embed' if cfg.tied_embeddings else 'lm_head')}
+        return one_f_one_b(self._block_fn(), params['blocks'],
+                           batch['tokens'], pipe_axis,
+                           ctx_option('microbatches', 1),
+                           tail_fn=tail, extra=batch['targets'],
+                           tail_params=tail_params,
+                           head_fn=head, head_params=head_params)
 
     def _chunk_nll(self, params, x, targets):
         logits = constrain(self._head_logits(params, x).astype(jnp.float32),

@@ -1,27 +1,97 @@
-"""Pipeline parallelism: GPipe microbatch schedule over the ``pipe`` axis.
+"""Pipeline parallelism: GPipe and 1F1B microbatch schedules over the
+``pipe`` axis.
 
 Absent from the reference (SURVEY.md §2.3: PP = No). TPU-native design:
 the repeated transformer blocks are parameter-stacked along a leading
 ``stage`` axis which shards over the ``pipe`` mesh axis; inside a manual
 shard_map region each pipe rank scans its local layer shard, and
-activations hop stage-to-stage with ``ppermute`` following the GPipe
-schedule (microbatches fill/drain the pipe; bubble fraction
-(pp-1)/(M+pp-1)). Autodiff through ppermute gives the backward schedule
-for free; XLA overlaps the hop DMA with the next microbatch's compute.
+activations hop stage-to-stage with ``ppermute``.
 
-Fill/drain efficiency: each rank r only holds a *valid* microbatch for
-schedule steps t in [r, r+M); outside that window the block compute is
+Two schedules:
+
+- :func:`gpipe` — fill/drain schedule, differentiated by autodiff's
+  reverse scan. Simple and composes with anything, but the backward
+  starts only after every microbatch's forward: all ``M`` microbatches'
+  residuals are live at the fwd/bwd boundary (the GPipe memory profile).
+- :func:`one_f_one_b` with ``tail_params`` — a REAL 1F1B: a
+  ``jax.custom_vjp`` whose hand-written backward interleaves one
+  recompute-forward and one backward per schedule step. A rank's live
+  working set is a circular stash of at most ``2(pp-1)+1`` microbatch
+  activations — bounded by the pipe depth, independent of ``M``. The
+  head/loss folds into the last stage (``tail_fn``) and the embedding
+  into the first (``head_fn``), so no full-batch ``[B, s, d]``
+  activation, logits slab, or input cotangent ever materializes: the
+  region's big tensors are all O(pp x microbatch). Cost: the backward
+  phase re-runs the forward chain to feed the stash (activations are
+  never saved across the fwd/bwd boundary), so a training step is
+  ~3 forward + 1 backward block passes — the standard 1F1B-with-full-
+  remat trade (memory bounded in pp buys arbitrarily many microbatches).
+
+Delivery is collective-clean: microbatch inputs ride a backward-rotating
+ppermute relay register (owner ``j % pp`` sits that many backward hops
+from stage 0; every rank injects its next owned microbatch each ``pp``
+steps) — one mb-sized hop per link per step, replacing the round-3
+masked-``psum`` delivery that moved ~pp× the bytes. ``M % pp`` may be
+ragged: residency slots are padded and masked.
+
+Fill/drain efficiency: rank r holds a *valid* microbatch only for
+schedule steps t in [r, r+M); outside that window block compute is
 skipped via ``lax.cond`` (a real XLA conditional — ``rank``/``t`` are
-runtime values inside the manual region), so the inherent bubble idles
-instead of burning FLOPs on garbage activations. Wall-clock per step is
-still one block time (some rank is always busy, and the per-step
-``ppermute`` aligns ranks), so the schedule's latency overhead remains
-the textbook (pp-1)/(M+pp-1) bubble — measured in
-tests/test_functional_api.py's pipeline parity tests.
+runtime values inside the manual region), so the inherent bubble
+(fraction (pp-1)/(M+pp-1)) idles instead of burning FLOPs.
 """
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+def _local_stack_fn(block_fn):
+    """(params_stack, h) -> (h, summed aux) over this rank's layers."""
+    def local_stack(stacked_params, h):
+        def body(c, p):
+            h, aux = c
+            h, a = block_fn(p, h)
+            return (h, aux + a.astype(jnp.float32)), None
+        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                               stacked_params)
+        return h, aux
+    return local_stack
+
+
+def _own_slices(arr_mb, rank, pp, share, M):
+    """Round-robin residency: this rank's owned microbatches (padded to
+    ``share`` slots; slots past M alias the last valid one and are
+    masked by schedule validity)."""
+    idx = jnp.clip(jnp.arange(share) * pp + rank, 0, M - 1)
+    return jnp.take(arr_mb, idx, axis=0)
+
+
+def _inject(own, reg, t, share, pp):
+    """Relay injection: at steps t % pp == 0 every rank loads its next
+    owned microbatch into the rotating register."""
+    slot = jnp.clip(t // pp, 0, share - 1)
+    fresh = lax.dynamic_index_in_dim(own, slot, 0, keepdims=False)
+    return jnp.where(jnp.equal(jnp.mod(t, pp), 0), fresh, reg)
+
+
+def _back_rotation(pp):
+    """Full backward rotation (toward stage 0): one relay hop/step."""
+    return [(i, (i - 1) % pp) for i in range(pp)]
+
+
+def _reassemble(own_out, axis_name, pp, share, mb, M, B):
+    """all_gather each rank's owned outputs and restore microbatch
+    order j = slot*pp + rank; slice off residency padding."""
+    gathered = lax.all_gather(own_out, axis_name)   # [pp, share, mb,...]
+    out = jnp.moveaxis(gathered, 0, 1)              # [share, pp, mb,...]
+    out = out.reshape((share * pp * mb,) + out.shape[3:])
+    return out[:B]
 
 
 def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
@@ -59,18 +129,10 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
     assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
     mb = B // M
     xs = x.reshape(M, mb, *x.shape[1:])
-
-    def local_stack(h):
-        def body(c, p):
-            h, aux = c
-            h, a = block_fn(p, h)
-            return (h, aux + a.astype(jnp.float32)), None
-        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                               stacked_params)
-        return h, aux
+    stack = _local_stack_fn(block_fn)
 
     if pp == 1:
-        return local_stack(x)
+        return stack(stacked_params, x)
 
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
@@ -85,7 +147,7 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
         # compute in the fill/drain bubble instead of processing garbage
         valid = jnp.logical_and(t >= rank, t < rank + M)
         out, aux = lax.cond(
-            valid, local_stack,
+            valid, lambda h: stack(stacked_params, h),
             lambda h: (h, jnp.zeros((), jnp.float32)), inp)
         aux_acc = aux_acc + aux
         # last stage records microbatch t-(pp-1) once the pipe is full
@@ -114,75 +176,83 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
 
 
 def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
-                tail_fn=None, extra=None):
-    """1F1B-memory-profile schedule with per-rank microbatch residency.
+                tail_fn=None, extra=None, tail_params=None,
+                head_fn=None, head_params=None):
+    """1F1B schedule with per-rank microbatch residency.
 
-    Same fill/steady/drain timing as :func:`gpipe` (the forward bubble
-    is inherent), but the memory contract differs — full-batch
-    activations never live across the schedule:
+    Same fill/steady/drain forward timing as :func:`gpipe` (the forward
+    bubble is inherent); the memory contract differs — full-batch
+    activations never live across the schedule. Two modes:
 
-    - inputs: rank ``r`` owns microbatches ``r, r+pp, ...`` (``M/pp`` of
-      them) and puts each on the wire (a masked ``psum`` delivery to
-      stage 0) exactly when the schedule consumes it — instead of every
-      rank closing over the full ``[M, mb]`` input stack;
-    - ``tail_fn(h, extra_mb)``: applied after the last stage's blocks,
-      PER MICROBATCH — fold the head + loss in here so the pipeline
-      emits ``[mb, seq]`` per-token losses instead of ``[mb, seq, dim]``
-      activations (and per-microbatch logits instead of a full-batch
-      ``[B, seq, vocab]`` slab). ``extra`` ([B, ...], e.g. targets)
-      streams through the pipe alongside the activations;
-    - outputs: the last stage's (tail) result for microbatch ``j`` is
-      delivered to its owner ``j % pp`` the step it is produced; each
-      rank holds only its ``[M/pp, mb, ...]`` share, and the (small)
-      full result is reassembled once at region exit.
+    - **fused (pass ``tail_params``)** — the real 1F1B: a custom-vjp
+      whose hand-written backward interleaves recompute-forwards and
+      backwards, bounding each rank's live activations at a
+      ``2(pp-1)+1``-slot circular stash (independent of ``M``). Fold
+      the head + loss into ``tail_fn(tail_params, h, extra_mb)`` (runs
+      on the last stage per microbatch) and the embedding into
+      ``head_fn(head_params, x_mb)`` (first stage) so the region's
+      inputs/outputs are token-sized, not activation-sized. Gradients
+      flow to ``stacked_params`` (local stage shard), ``tail_params``
+      and ``head_params`` (replicated via psum), and to a floating
+      ``x``. ``M % pp`` may be ragged.
+    - **legacy (no ``tail_params``)** — forward schedule differentiated
+      by autodiff's reverse scan; per-step residuals are
+      microbatch-sized but all ``M + pp - 1`` of them are live at the
+      fwd/bwd boundary. ``tail_fn(h, extra_mb)`` here CLOSES OVER its
+      params (autodiff sees through the closure).
 
-    The fwd/bwd *interleave* itself is autodiff's reverse scan, not a
-    hand-written schedule; what is delivered (and asserted by
-    ``compiled.memory_analysis()`` in the tests) is the 1F1B working-set
-    property — live full-batch buffers are eliminated and per-step
-    residuals are microbatch-sized.
-
-    Requires ``M % pp == 0`` (round-robin residency); use ``gpipe`` for
-    ragged microbatch counts.
+    Inputs ride a backward-rotating ppermute relay (one mb hop per link
+    per step); only the small per-microbatch tail outputs use masked
+    psum delivery to their owner rank.
     """
+    pp = lax.axis_size(axis_name)
+    M = int(microbatches)
+    stack = _local_stack_fn(block_fn)
+
+    if pp == 1:
+        if head_fn is not None:
+            x = head_fn(head_params, x)
+        h, aux = stack(stacked_params, x)
+        if tail_fn is not None:
+            h = tail_fn(tail_params, h, extra) if tail_params is not None \
+                else tail_fn(h, extra)
+        return h, aux
+
+    if tail_params is not None or head_params is not None:
+        if tail_fn is not None and tail_params is None:
+            raise ValueError(
+                'fused 1F1B (head_params given) needs the param-explicit '
+                'tail convention: pass tail_params with '
+                'tail_fn(tail_params, h, extra_mb) — a closure-style '
+                'tail_fn(h, extra) would silently lose its parameter '
+                'gradients')
+        return _fused_1f1b(block_fn, stacked_params, x, axis_name, M,
+                           tail_fn, extra, tail_params, head_fn,
+                           head_params)
+    return _legacy_1f1b(block_fn, stacked_params, x, axis_name, M,
+                        tail_fn, extra)
+
+
+def _legacy_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
+                 extra):
+    """Autodiff-through-the-scan 1F1B memory profile (see
+    :func:`one_f_one_b`)."""
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B = x.shape[0]
-    M = int(microbatches)
     assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
     mb = B // M
-
-    def local_stack(h):
-        def body(c, p):
-            h, aux = c
-            h, a = block_fn(p, h)
-            return (h, aux + a.astype(jnp.float32)), None
-        (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                               stacked_params)
-        return h, aux
-
-    if pp == 1:
-        h, aux = local_stack(x)
-        if tail_fn is not None:
-            h = tail_fn(h, extra)
-        return h, aux
-    if M % pp:
-        raise ValueError(
-            "pp_schedule='1f1b' needs microbatches %% pp == 0 "
-            '(got M=%d, pp=%d); use gpipe for ragged counts' % (M, pp))
-
-    share = M // pp
-    own_idx = jnp.arange(share) * pp + rank   # round-robin residency
+    share = _ceil_div(M, pp)
+    stack = _local_stack_fn(block_fn)
 
     def to_mb(a):
         return a.reshape(M, mb, *a.shape[1:])
 
-    xs = to_mb(x)
-    own_in = jnp.take(xs, own_idx, axis=0)
-    extra_s = None if extra is None else to_mb(extra)
-    own_extra = None if extra is None else jnp.take(extra_s, own_idx,
-                                                    axis=0)
+    own_in = _own_slices(to_mb(x), rank, pp, share, M)
+    own_extra = None if extra is None else \
+        _own_slices(to_mb(extra), rank, pp, share, M)
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    back_rot = _back_rotation(pp)
     zero_h = jnp.zeros((mb,) + x.shape[1:], x.dtype)
     zero_e = None if extra is None else \
         jnp.zeros((mb,) + extra.shape[1:], extra.dtype)
@@ -193,42 +263,36 @@ def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
     out_shape = jax.eval_shape(tail, zero_h, zero_e)
     zero_out = jnp.zeros(out_shape.shape, out_shape.dtype)
 
-    def deliver(mine, zero, cond_):
-        """Masked-psum delivery of one microbatch-sized tensor."""
-        return lax.psum(jnp.where(cond_, mine, zero), axis_name)
-
     def step(carry, t):
-        state_h, state_e, own_out, aux_acc = carry
-        # input delivery: the owner of microbatch t puts it on the wire
-        owner = jnp.mod(t, pp)
-        slot = jnp.clip(t // pp, 0, share - 1)
-        emit = jnp.logical_and(rank == owner, t < M)
-        feed_h = deliver(lax.dynamic_index_in_dim(own_in, slot, 0,
-                                                  keepdims=False),
-                         zero_h, emit)
-        inp_h = jnp.where(rank == 0, feed_h, state_h)
-        if extra is None:
-            inp_e = None
-        else:
-            feed_e = deliver(lax.dynamic_index_in_dim(own_extra, slot, 0,
-                                                      keepdims=False),
-                             zero_e, emit)
-            inp_e = jnp.where(rank == 0, feed_e, state_e)
-        valid = jnp.logical_and(t >= rank, t < rank + M)
+        reg_x, reg_e, state_h, state_e, own_out, aux_acc = carry
+        # input relay: every pp steps each rank injects its next owned
+        # microbatch; one backward hop per step delivers one microbatch
+        # per step to stage 0
+        reg_x = _inject(own_in, reg_x, t, share, pp)
+        if extra is not None:
+            reg_e = _inject(own_extra, reg_e, t, share, pp)
+        inp_h = jnp.where(rank == 0, reg_x, state_h)
+        inp_e = None if extra is None else \
+            jnp.where(rank == 0, reg_e, state_e)
+        valid = jnp.logical_and(t >= rank, t - rank < M)
         h, aux = lax.cond(
-            valid, local_stack,
+            valid, lambda v: stack(stacked_params, v),
             lambda v: (v, jnp.zeros((), jnp.float32)), inp_h)
         aux_acc = aux_acc + aux
-        # the last stage's per-microbatch tail (head/loss when folded);
-        # other ranks compute it on pipeline-register values and the
-        # result is masked out — the bubble idles either way, and the
-        # full-batch head this replaces also ran on every rank
+        # the last stage's per-microbatch tail (head/loss when folded)
+        # runs UNCONDITIONALLY and is masked after: rank-divergent conds
+        # around code with sharding constraints deadlock when the
+        # partitioner inserts resharding collectives in one branch only
+        # (the full-batch head this replaces also ran on every rank)
+        j = t - (pp - 1)
+        is_out = jnp.logical_and(rank == pp - 1,
+                                 jnp.logical_and(j >= 0, j < M))
         out_val = tail(h, inp_e)
         # output delivery: microbatch j leaves the last stage this step
-        j = t - (pp - 1)
-        done = deliver(out_val, zero_out,
-                       jnp.logical_and(rank == pp - 1, j >= 0))
-        take = jnp.logical_and(j >= 0, jnp.mod(j, pp) == rank)
+        # (masked psum of the SMALL tail output)
+        done = lax.psum(jnp.where(is_out, out_val, zero_out), axis_name)
+        take = jnp.logical_and(jnp.logical_and(j >= 0, j < M),
+                               jnp.mod(j, pp) == rank)
         slot_out = jnp.clip(j // pp, 0, share - 1)
         prev = lax.dynamic_index_in_dim(own_out, slot_out, 0,
                                         keepdims=False)
@@ -237,15 +301,283 @@ def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
         nxt_h = lax.ppermute(h, axis_name, fwd_perm)
         nxt_e = None if extra is None else \
             lax.ppermute(inp_e, axis_name, fwd_perm)
-        return (nxt_h, nxt_e, own_out, aux_acc), None
+        reg_x = lax.ppermute(reg_x, axis_name, back_rot)
+        if extra is not None:
+            reg_e = lax.ppermute(reg_e, axis_name, back_rot)
+        return (reg_x, reg_e, nxt_h, nxt_e, own_out, aux_acc), None
 
     own_out = jnp.zeros((share,) + zero_out.shape, zero_out.dtype)
-    (_, _, own_out, aux_acc), _ = lax.scan(
-        step, (zero_h, zero_e, own_out, jnp.zeros((), jnp.float32)),
-        jnp.arange(M + pp - 1))
-    # reassemble once, at exit: gathered[r, s] is microbatch s*pp + r
-    gathered = lax.all_gather(own_out, axis_name)  # [pp, share, mb, ...]
-    out = jnp.moveaxis(gathered, 0, 1).reshape(
-        (B,) + zero_out.shape[1:])
+    carry0 = (zero_h, zero_e, zero_h, zero_e, own_out,
+              jnp.zeros((), jnp.float32))
+    (_, _, _, _, own_out, aux_acc), _ = lax.scan(
+        step, carry0, jnp.arange(M + pp - 1))
+    out = _reassemble(own_out, axis_name, pp, share, mb, M, B)
     aux = lax.psum(aux_acc, axis_name) / M
     return out, aux
+
+
+def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
+                extra, tail_params, head_fn, head_params):
+    """Custom-vjp 1F1B (see :func:`one_f_one_b`): forward saves NO
+    activations; the backward phase re-runs the forward chain and
+    interleaves one recompute-vjp per step, stash bounded at
+    ``2(pp-1)+1`` microbatches per rank."""
+    pp = lax.axis_size(axis_name)
+    B = x.shape[0]
+    assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
+    mb = B // M
+    share = _ceil_div(M, pp)
+    stack = _local_stack_fn(block_fn)
+    if tail_params is None:
+        tail_params = {}
+    if head_params is None:
+        head_params = {}
+    if tail_fn is None:
+        tail_fn = lambda tp, h, e: h           # noqa: E731
+    if head_fn is None:
+        head_fn = lambda hp, v: v              # noqa: E731
+    # extra always present internally (dummy keeps the schedule uniform)
+    have_extra = extra is not None
+    if not have_extra:
+        extra = jnp.zeros((B, 1), jnp.int32)
+    elif jnp.issubdtype(jnp.asarray(extra).dtype, jnp.inexact):
+        # the hand-written backward does not propagate d(extra) (the
+        # tail cotangent for it is discarded); int targets — the lm/
+        # classification case — have no cotangent, but a float extra
+        # (soft labels, distillation targets) would silently train with
+        # d(extra)=0. Refuse rather than diverge from the legacy path.
+        raise ValueError(
+            'fused 1F1B does not backpropagate into a floating-point '
+            "`extra` stream; use integer targets or the legacy "
+            'schedule (no tail_params)')
+    x_differentiable = jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    rev_perm = [(i, i - 1) for i in range(1, pp)]
+    back_rot = _back_rotation(pp)
+
+    def zero_ct(v):
+        """Cotangent for a possibly-integer primal leaf."""
+        v = jnp.asarray(v)
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            return jnp.zeros_like(v)
+        return np.zeros(v.shape, jax.dtypes.float0)
+
+    def run_forward(sp, tp, hp, x_, e_):
+        rank = lax.axis_index(axis_name)
+        xs = x_.reshape(M, mb, *x_.shape[1:])
+        es = e_.reshape(M, mb, *e_.shape[1:])
+        own_x = _own_slices(xs, rank, pp, share, M)
+        own_e = _own_slices(es, rank, pp, share, M)
+        zero_x = jnp.zeros_like(own_x[0])
+        zero_e = jnp.zeros_like(own_e[0])
+        h_shape = jax.eval_shape(lambda v: head_fn(hp, v), zero_x)
+        zero_h = jnp.zeros(h_shape.shape, h_shape.dtype)
+        out_shape = jax.eval_shape(lambda h, e: tail_fn(tp, h, e),
+                                   zero_h, zero_e)
+        zero_out = jnp.zeros(out_shape.shape, out_shape.dtype)
+
+        def step(carry, t):
+            reg_x, reg_e, state_h, state_e, own_out, aux_acc = carry
+            reg_x = _inject(own_x, reg_x, t, share, pp)
+            reg_e = _inject(own_e, reg_e, t, share, pp)
+            # first stage embeds its incoming microbatch (head folded
+            # in). head/tail run UNCONDITIONALLY and mask after: a
+            # rank-divergent cond around code with sharding constraints
+            # deadlocks when the partitioner inserts resharding
+            # collectives in one branch only (found by the 8-device
+            # dp4xpp2 dryrun); only the bare block stack may sit under
+            # the validity cond.
+            inp_h = jnp.where(rank == 0, head_fn(hp, reg_x), state_h)
+            inp_e = jnp.where(rank == 0, reg_e, state_e)
+            valid = jnp.logical_and(t >= rank, t - rank < M)
+            h, aux = lax.cond(
+                valid, lambda v: stack(sp, v),
+                lambda v: (v, jnp.zeros((), jnp.float32)), inp_h)
+            aux_acc = aux_acc + aux
+            j = t - (pp - 1)
+            is_out = jnp.logical_and(rank == pp - 1,
+                                     jnp.logical_and(j >= 0, j < M))
+            out_val = tail_fn(tp, h, inp_e)
+            done = lax.psum(jnp.where(is_out, out_val, zero_out),
+                            axis_name)
+            take = jnp.logical_and(jnp.logical_and(j >= 0, j < M),
+                                   jnp.mod(j, pp) == rank)
+            slot_out = jnp.clip(j // pp, 0, share - 1)
+            prev = lax.dynamic_index_in_dim(own_out, slot_out, 0,
+                                            keepdims=False)
+            own_out = lax.dynamic_update_index_in_dim(
+                own_out, jnp.where(take, done, prev), slot_out, 0)
+            nxt_h = lax.ppermute(h, axis_name, fwd_perm)
+            nxt_e = lax.ppermute(inp_e, axis_name, fwd_perm)
+            reg_x = lax.ppermute(reg_x, axis_name, back_rot)
+            reg_e = lax.ppermute(reg_e, axis_name, back_rot)
+            return (reg_x, reg_e, nxt_h, nxt_e, own_out, aux_acc), None
+
+        own_out = jnp.zeros((share,) + zero_out.shape, zero_out.dtype)
+        carry0 = (zero_x, zero_e, zero_h, zero_e, own_out,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, _, own_out, aux_acc), _ = lax.scan(
+            step, carry0, jnp.arange(M + pp - 1))
+        out = _reassemble(own_out, axis_name, pp, share, mb, M, B)
+        aux = lax.psum(aux_acc, axis_name) / M
+        return out, aux
+
+    def run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux):
+        """Interleaved recompute-forward + backward schedule.
+
+        Timing (step u): chain-fwd of microbatch j=u-r at rank r
+        (received inputs stashed, circular, 2(pp-1)+1 slots);
+        tail-vjp of j=u-(pp-1) at the last rank the step its chain
+        output appears; stack-vjp of j=u-2(pp-1)+r at rank r, with the
+        activation cotangent hopping one rank backward per step. The
+        stash entry written at chain-fwd step j+r is consumed at
+        stack-vjp step j+2(pp-1)-r — retention <= 2(pp-1), so the
+        circular buffer never overwrites a live slot.
+        """
+        rank = lax.axis_index(axis_name)
+        S = 2 * (pp - 1) + 1
+        T = M + 2 * (pp - 1)
+        xs = x_.reshape(M, mb, *x_.shape[1:])
+        es = e_.reshape(M, mb, *e_.shape[1:])
+        own_x = _own_slices(xs, rank, pp, share, M)
+        own_e = _own_slices(es, rank, pp, share, M)
+        cts = ct_out.reshape(M, mb, *ct_out.shape[1:])
+        zero_x = jnp.zeros_like(own_x[0])
+        zero_e = jnp.zeros_like(own_e[0])
+        h_shape = jax.eval_shape(lambda v: head_fn(hp, v), zero_x)
+        zero_h = jnp.zeros(h_shape.shape, h_shape.dtype)
+        ct_aux_mb = (ct_aux / M).astype(jnp.float32)
+
+        def stack_fwd(v):
+            return stack(sp, v)[0]
+
+        g_sp0 = jax.tree.map(jnp.zeros_like, sp)
+        g_tp0 = jax.tree.map(jnp.zeros_like, tp)
+        g_hp0 = jax.tree.map(jnp.zeros_like, hp)
+        dx0 = jnp.zeros((M,) + zero_x.shape, zero_x.dtype) \
+            if x_differentiable else None
+
+        def step(carry, u):
+            (reg_x, reg_e, state_h, state_e, stash_x, stash_h,
+             ct_reg, g_sp, g_tp, g_hp, dx_buf) = carry
+            # ---- recompute-forward chain (identical to run_forward) --
+            reg_x = _inject(own_x, reg_x, u, share, pp)
+            reg_e = _inject(own_e, reg_e, u, share, pp)
+            inp_h = jnp.where(rank == 0, head_fn(hp, reg_x), state_h)
+            inp_e = jnp.where(rank == 0, reg_e, state_e)
+            valid_f = jnp.logical_and(u >= rank, u - rank < M)
+            h = lax.cond(valid_f, stack_fwd, lambda v: v, inp_h)
+            # stash this step's received input (rank 0: the raw/token
+            # microbatch; others: the incoming activation). The slot
+            # being overwritten was consumed at step u-1 (see docstring)
+            slot_w = jnp.mod(u, S)
+            stash_x = lax.dynamic_update_index_in_dim(
+                stash_x, reg_x, slot_w, 0)
+            stash_h = lax.dynamic_update_index_in_dim(
+                stash_h, inp_h, slot_w, 0)
+            # ---- tail vjp at the last rank, same step as chain out ---
+            # run UNCONDITIONALLY with a masked cotangent (J^T*0 = 0 on
+            # off ranks/steps): a rank-divergent cond around the tail's
+            # sharding constraints deadlocks (see run_forward note)
+            j_t = u - (pp - 1)
+            valid_t = jnp.logical_and(rank == pp - 1,
+                                      jnp.logical_and(j_t >= 0, j_t < M))
+            ct_mb = lax.dynamic_index_in_dim(
+                cts, jnp.clip(j_t, 0, M - 1), 0, keepdims=False)
+            ct_mb = jnp.where(valid_t, ct_mb, jnp.zeros_like(ct_mb))
+            _, tail_vjp_fn = jax.vjp(
+                lambda tp_, h_, e_in: tail_fn(tp_, h_, e_in),
+                tp, h, inp_e)
+            d_tp, ct_h_tail = tail_vjp_fn(ct_mb)[:2]
+            g_tp = jax.tree.map(jnp.add, g_tp, d_tp)
+            # ---- stack vjp (the 1F1B backward of microbatch j_b) -----
+            j_b = u - 2 * (pp - 1) + rank
+            valid_b = jnp.logical_and(j_b >= 0, j_b < M)
+            ct_in = jnp.where(rank == pp - 1, ct_h_tail, ct_reg)
+            slot_r = jnp.mod(u - 2 * (pp - 1) + 2 * rank, S)
+            h_in_b = lax.dynamic_index_in_dim(stash_h, slot_r, 0,
+                                              keepdims=False)
+            x_in_b = lax.dynamic_index_in_dim(stash_x, slot_r, 0,
+                                              keepdims=False)
+
+            # Rank 0's stashed input is pre-head (tokens); recompute the
+            # head UNCONDITIONALLY on every rank (uniform program — the
+            # head's sharding constraints must not sit in rank-divergent
+            # control flow) and select the effective stack input.
+            head_out_b, head_vjp_fn = jax.vjp(
+                lambda hp_, xv: head_fn(hp_, xv), hp, x_in_b)
+            h_eff = jnp.where(rank == 0, head_out_b, h_in_b)
+
+            def stack_vjp(args):
+                hv, ct = args
+                _, vjp_fn = jax.vjp(
+                    lambda sp_, h_: stack(sp_, h_), sp, hv)
+                return vjp_fn((ct, ct_aux_mb))
+
+            d_sp, d_h = lax.cond(
+                valid_b, stack_vjp,
+                lambda args: (g_sp0, jnp.zeros_like(args[0])),
+                (h_eff, ct_in))
+            # head backward with a rank/validity-masked cotangent
+            # (J^T*0 = 0 elsewhere) — uniform across ranks
+            ct_head = jnp.where(
+                jnp.logical_and(valid_b, rank == 0), d_h,
+                jnp.zeros_like(d_h))
+            d_hp, d_x = head_vjp_fn(ct_head)
+            ct_prev = d_h
+            if x_differentiable:
+                take_dx = jnp.logical_and(valid_b, rank == 0)
+                slot_dx = jnp.clip(j_b, 0, M - 1)
+                prev_dx = lax.dynamic_index_in_dim(dx_buf, slot_dx, 0,
+                                                   keepdims=False)
+                dx_buf = lax.dynamic_update_index_in_dim(
+                    dx_buf, jnp.where(take_dx, d_x, prev_dx),
+                    slot_dx, 0)
+            g_sp = jax.tree.map(jnp.add, g_sp, d_sp)
+            g_hp = jax.tree.map(jnp.add, g_hp, d_hp)
+            # ---- rotations -------------------------------------------
+            ct_reg = lax.ppermute(ct_prev, axis_name, rev_perm)
+            state_h = lax.ppermute(h, axis_name, fwd_perm)
+            state_e = lax.ppermute(inp_e, axis_name, fwd_perm)
+            reg_x = lax.ppermute(reg_x, axis_name, back_rot)
+            reg_e = lax.ppermute(reg_e, axis_name, back_rot)
+            return (reg_x, reg_e, state_h, state_e, stash_x, stash_h,
+                    ct_reg, g_sp, g_tp, g_hp, dx_buf), None
+
+        stash_x = jnp.zeros((S,) + zero_x.shape, zero_x.dtype)
+        stash_h = jnp.zeros((S,) + zero_h.shape, zero_h.dtype)
+        carry0 = (zero_x, zero_e, zero_h, zero_e, stash_x, stash_h,
+                  jnp.zeros_like(zero_h), g_sp0, g_tp0, g_hp0, dx0)
+        carry, _ = lax.scan(step, carry0, jnp.arange(T))
+        (_, _, _, _, _, _, _, g_sp, g_tp, g_hp, dx_buf) = carry
+        # tail/head params are replicated primals: their cotangent is the
+        # sum of every rank's (masked) contributions
+        g_tp = jax.tree.map(lambda v: lax.psum(v, axis_name), g_tp)
+        g_hp = jax.tree.map(lambda v: lax.psum(v, axis_name), g_hp)
+        if x_differentiable:
+            # input cotangent materializes only here, at the interface
+            # (rank 0 produced every microbatch's dx; replicate once)
+            dx = lax.psum(
+                jnp.where(rank == 0, dx_buf, jnp.zeros_like(dx_buf)),
+                axis_name)
+            dx = dx.reshape(x_.shape).astype(x_.dtype)
+        else:
+            dx = zero_ct(x_)
+        return g_sp, g_tp, g_hp, dx, zero_ct(e_)
+
+    @jax.custom_vjp
+    def fused(sp, tp, hp, x_, e_):
+        return run_forward(sp, tp, hp, x_, e_)
+
+    def fused_fwd(sp, tp, hp, x_, e_):
+        out = run_forward(sp, tp, hp, x_, e_)
+        return out, (sp, tp, hp, x_, e_)
+
+    def fused_bwd(res, cts):
+        sp, tp, hp, x_, e_ = res
+        ct_out, ct_aux = cts
+        return run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+    return fused(stacked_params, tail_params, head_params, x, extra)

@@ -87,23 +87,28 @@ def test_pipeline_1f1b_matches_dp(batch):
     assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
 
 
-def test_pipeline_1f1b_ragged_microbatches_rejected(batch):
+def test_pipeline_1f1b_ragged_microbatches(batch):
+    """M % pp may be ragged — even M < pp (round-4: residency slots are
+    padded and masked, lifting the round-3 M %% pp == 0 restriction):
+    parity with DP holds at M=2, pp=4."""
     cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=4)
     model = TransformerLM(cfg)
-    with pytest.raises(ValueError, match='1f1b'):
-        run_losses(model, ParallelSpec(pp=2, microbatches=1,
-                                       pp_schedule='1f1b'), batch,
-                   steps=1)
+    base = run_losses(model, ParallelSpec(), batch, steps=2)
+    f1b = run_losses(model, ParallelSpec(pp=4, microbatches=2,
+                                         pp_schedule='1f1b'), batch,
+                     steps=2)
+    assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
 
 
 def test_pipeline_1f1b_reduces_peak_memory():
-    """The point of 1F1B: folding the head/loss into the last stage
-    (per-microbatch, checkpointed) means no full-batch [B, s, vocab]
-    logits slab and no full-batch activation stacks live across the
-    schedule — the compiled step's temp memory must come in below
-    GPipe's. Vocab is sized so the logits slab dominates (measured:
-    ~334 MB gpipe vs ~291 MB 1f1b at these shapes on the CPU
-    accounting)."""
+    """The point of 1F1B: the custom-vjp backward interleaves
+    recompute-forwards and backwards with a 2(pp-1)+1-slot circular
+    stash, so live activations are bounded by the PIPE DEPTH — while
+    GPipe's autodiff-of-scan holds all M+pp-1 microbatch residuals at
+    the fwd/bwd boundary (plus the full-batch logits slab the folded
+    tail eliminates). At pp=4, M=16 the compiled step's temp memory
+    must come in at less than HALF of GPipe's (round-2 target; the
+    round-3 masked-psum approximation managed only ~13%)."""
     import dataclasses
 
     import optax as _optax
@@ -117,17 +122,22 @@ def test_pipeline_1f1b_reduces_peak_memory():
     big = {'tokens': rng.randint(0, 4096, (32, 128)),
            'targets': rng.randint(0, 4096, (32, 128))}
 
-    def temp_bytes(schedule):
+    def temp_bytes(schedule, microbatches):
         tr = Trainer(model, _optax.sgd(0.1),
-                     spec=ParallelSpec(pp=2, dp=1, microbatches=8,
+                     spec=ParallelSpec(pp=4, dp=1,
+                                       microbatches=microbatches,
                                        pp_schedule=schedule))
         state = tr.init(jax.random.PRNGKey(0))
         compiled = tr.compile_step(state, big)
         return compiled.memory_analysis().temp_size_in_bytes
 
-    gpipe_bytes = temp_bytes('gpipe')
-    f1b_bytes = temp_bytes('1f1b')
-    assert f1b_bytes < 0.95 * gpipe_bytes, (f1b_bytes, gpipe_bytes)
+    gpipe_bytes = temp_bytes('gpipe', 16)
+    f1b_bytes = temp_bytes('1f1b', 16)
+    assert f1b_bytes < 0.5 * gpipe_bytes, (f1b_bytes, gpipe_bytes)
+    # the 1F1B bound is set by pp, not M: doubling the microbatch
+    # count must not grow the working set materially (>15%)
+    f1b_m8 = temp_bytes('1f1b', 8)
+    assert f1b_bytes < 1.15 * f1b_m8, (f1b_bytes, f1b_m8)
 
 
 def test_moe_aux_loss_kept_under_pipelining(batch):
