@@ -1,0 +1,133 @@
+"""Hand-scheduled training BatchNorm: minimum HBM passes, pure XLA.
+
+The round-4 per-op profile of the ResNet-101 step (v5e, batch 256)
+showed ~60% of step time in BatchNorm-related reductions, and — the
+actionable part — XLA emitted E[x] (convert+reduce) and E[x^2]
+(multiply+reduce) as SEPARATE fusions: two full HBM reads of every
+activation per BN site, plus more in the autodiff backward. This module
+rewrites training BN as a ``jax.custom_vjp`` whose passes are counted
+by hand:
+
+- forward: ONE variadic reduce computes both moment sums in a single
+  read (a single Reduce HLO cannot be split), then one read+write for
+  the folded normalize+affine;
+- backward: ONE variadic reduce for (sum dy, sum dy*x) — d_gamma is
+  recovered from them without a separate pass — then one read of
+  (dy, x) for dx. The classic BN gradient
+  ``dx = g*rsqrt(var+eps) * (dy - (db + xhat*dg)/n)`` fuses into that
+  single elementwise pass.
+
+Everything [B,H,W,C]-sized stays in the activation dtype (bf16 in the
+benchmark configs); f32 lives only in [C] vectors and reduce
+accumulators. The reference gets its BN from cuDNN via the TF runtime
+(SURVEY.md §2.2); this is the TPU-native equivalent, at the XLA graph
+level where the conv emitter's layouts are undisturbed (a Pallas
+variant was measured slower end-to-end: kernel-boundary layout copies
+outweigh the saved passes).
+
+Returns (y, mean, var) — mean/var feed the EMA state channel, which is
+deliberately non-differentiable (reference semantics: moving statistics
+are not part of the loss); their cotangents are ignored.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _moment_sums(x):
+    """(sum x, sum x^2) over all but the channel axis, in ONE pass
+    (a single variadic Reduce HLO; f32 accumulation from the
+    activation dtype)."""
+    xf = x.astype(jnp.float32)
+    return lax.reduce(
+        (xf, xf * xf), (jnp.float32(0), jnp.float32(0)),
+        lambda c, v: (c[0] + v[0], c[1] + v[1]),
+        tuple(range(x.ndim - 1)))
+
+
+def _sum_dy_dyx(dy, x):
+    """(sum dy, sum dy*x) per channel in ONE pass."""
+    dyf = dy.astype(jnp.float32)
+    return lax.reduce(
+        (dyf, dyf * x.astype(jnp.float32)),
+        (jnp.float32(0), jnp.float32(0)),
+        lambda c, v: (c[0] + v[0], c[1] + v[1]),
+        tuple(range(dy.ndim - 1)))
+
+
+@jax.custom_vjp
+def moments(x):
+    """Differentiable single-pass batch moments: (E[x], E[x^2]) over
+    all but the channel axis. One variadic Reduce HLO = one HBM read
+    (JAX cannot autodiff a variadic ``lax.reduce``, hence the
+    closed-form vjp: d/dx = (dE1 + 2x*dE2)/n)."""
+    n = x.size // x.shape[-1]
+    s1, s2 = _moment_sums(x)
+    return s1 / n, s2 / n
+
+
+def _moments_fwd(x):
+    return moments(x), x
+
+
+def _moments_bwd(x, cts):
+    d1, d2 = cts
+    n = x.size // x.shape[-1]
+    dt = x.dtype
+    dx = (d1 / n).astype(dt) + x * (2.0 * d2 / n).astype(dt)
+    return (dx,)
+
+
+moments.defvjp(_moments_fwd, _moments_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x, scale, bias, eps):
+    """Training-mode BN over the leading axes of NHWC ``x``; returns
+    ``(y, mean, var)`` with y in x's dtype and batch statistics in f32.
+    """
+    y, mean, var, _ = _bn_fwd_impl(x, scale, bias, eps)
+    return y, mean, var
+
+
+def _bn_fwd_impl(x, scale, bias, eps):
+    n = x.size // x.shape[-1]
+    s1, s2 = _moment_sums(x)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    a = scale * lax.rsqrt(var + eps)
+    b = bias - mean * a
+    dt = x.dtype
+    y = x * a.astype(dt) + b.astype(dt)
+    return y, mean, var, a
+
+
+def _bn_fwd(x, scale, bias, eps):
+    y, mean, var, a = _bn_fwd_impl(x, scale, bias, eps)
+    return (y, mean, var), (x, scale, mean, var)
+
+
+def _bn_bwd(eps, res, cts):
+    x, scale, mean, var = res
+    dy = cts[0]   # d_mean/d_var cotangents ignored: EMA state channel
+    n = x.size // x.shape[-1]
+    inv = lax.rsqrt(var + eps)
+    sdy, sdyx = _sum_dy_dyx(dy, x)
+    db = sdy
+    # d_gamma = sum dy*xhat = (sum dy*x - mean*sum dy) * inv
+    dg = (sdyx - mean * sdy) * inv
+    # dx = gamma*inv * (dy - (db + xhat*dg)/n), with
+    # xhat = (x - mean)*inv, folded to ONE multiply-add in x:
+    #   dx = k1*dy + k2*x + k3  (per-channel k's)
+    g_inv = scale * inv
+    k1 = g_inv
+    k2 = -g_inv * dg * inv / n
+    k3 = -g_inv * (db - dg * inv * mean) / n
+    dt = x.dtype
+    dx = dy * k1.astype(dt) + x * k2.astype(dt) + k3.astype(dt)
+    return dx, dg.astype(scale.dtype), db.astype(scale.dtype)
+
+
+batch_norm_train.defvjp(_bn_fwd, _bn_bwd)

@@ -75,32 +75,60 @@ class BatchNorm(Module):
                 'ema_var': ParamDef((self.ch,), (None,), 'ones',
                                     trainable=False)}
 
-    def apply(self, params, x):
-        from autodist_tpu.models.core import (is_training,
-                                              record_state_update)
+    def coeffs_from_moments(self, params, mean, m2):
+        """Folded normalize+affine coefficients (a, b) from first/second
+        raw moments — the moments may come from an XLA reduce over the
+        activation OR from the fused conv kernel's epilogue sums
+        (kernels/conv_bn.py), which cost zero extra HBM traffic.
+        Records the EMA state updates (training mode)."""
+        from autodist_tpu.models.core import record_state_update
+        var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+        m = self.momentum
+        record_state_update(
+            self, 'ema_mean', m * params['ema_mean'] + (1 - m) * mean)
+        record_state_update(
+            self, 'ema_var', m * params['ema_var'] + (1 - m) * var)
+        a = params['scale'] * jax.lax.rsqrt(var + self.eps)
+        b = params['bias'] - mean * a
+        return a, b
+
+    def coeffs(self, params, x):
+        """(a, b) such that the normalized output is ``x*a + b``."""
+        from autodist_tpu.models.core import is_training
         if is_training():
             # fused-BN formulation: one pass of f32-ACCUMULATED moments
             # (E[x], E[x^2]); the f32 convert fuses into the reduces, so
-            # no [B,H,W,C] f32 temporary hits HBM
+            # no [B,H,W,C] f32 temporary hits HBM. (The profile shows
+            # XLA emits these as multi-output reduce fusions already; a
+            # custom variadic-reduce variant — kernels/batch_norm.py
+            # moments() — measured neutral-to-slower, see apply().)
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=(0, 1, 2))
             m2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
-            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
-            m = self.momentum
-            record_state_update(
-                self, 'ema_mean', m * params['ema_mean'] + (1 - m) * mean)
-            record_state_update(
-                self, 'ema_var', m * params['ema_var'] + (1 - m) * var)
-        else:
-            mean = params['ema_mean']
-            var = params['ema_var']
+            return self.coeffs_from_moments(params, mean, m2)
+        mean = params['ema_mean']
+        var = params['ema_var']
+        a = params['scale'] * jax.lax.rsqrt(var + self.eps)
+        b = params['bias'] - mean * a
+        return a, b
+
+    def apply(self, params, x):
         # normalize+affine folded to one per-channel multiply-add: the
         # [C]-vector coefficients are computed in f32, the elementwise
         # pass over the activations reads and writes the model dtype
-        # (bf16 on TPU) — the round-2 path upcast every activation to
-        # f32 here, doubling the HBM bytes of the BN stage
-        a = params['scale'] * jax.lax.rsqrt(var + self.eps)
-        b = params['bias'] - mean * a
+        # (bf16 on TPU).
+        #
+        # Round-4 measurement note: a fully hand-scheduled BN
+        # (kernels/batch_norm.py batch_norm_train: variadic one-pass
+        # moments + closed-form two-pass backward) was built and is
+        # numerically exact, but benches SLIGHTLY SLOWER here (v5e
+        # ResNet-101 train 180 ms vs 174 ms, fwd 66 vs 55) — the
+        # per-op profile shows XLA already emits multi-output
+        # reduce+elementwise fusions for this formulation (one pass
+        # computing dbeta, dgamma AND dx), and the custom_vjp boundary
+        # blocks some cross-op fusion. Kept as an opt-in building
+        # block; this graph-level form stays the default.
+        a, b = self.coeffs(params, x)
         y = x.astype(self.dtype) * a.astype(self.dtype) + \
             b.astype(self.dtype)
         return y
@@ -123,6 +151,85 @@ def global_avg_pool(x):
     return jnp.mean(x, axis=(1, 2))
 
 
+def _fused_conv_enabled():
+    """Fused-pointwise dispatch gate: '1' opts in to the Pallas
+    conv+BN kernel (interpret mode on CPU — the test tier); default
+    OFF. Measured on v5e (ResNet-101, batch 256): the kernel's MXU
+    throughput is fine late-stage, but Pallas pins its operands to
+    default tiled layouts, and the layout-conversion copies at every
+    kernel boundary cost more than the saved BN passes (train step
+    241 ms gated / 317 ms ungated vs 174 ms without the kernel; the
+    per-op profile shows XLA already emits the BN statistics and
+    backward as single multi-output fusions, so there was less to save
+    than the fusion names suggested). Full measurement notes in
+    BASELINE.md."""
+    import os
+    return os.environ.get('AUTODIST_FUSED_CONV', '0') == '1'
+
+
+def _fused_max_rows():
+    """Row-count ceiling for the fused kernel (0 = no limit). Pallas
+    forces default tiled layouts on its operands, so every kernel call
+    pays layout-conversion copies at its boundaries; on the huge
+    early-stage activations those copies outweigh the saved BN passes
+    (measured on v5e), while late stages win. Tunable for benchmarking."""
+    import os
+    v = os.environ.get('AUTODIST_FUSED_CONV_MAX_ROWS', '')
+    return int(v) if v else 120000
+
+
+def _fused_pointwise_ok(conv, x):
+    from autodist_tpu.kernels import conv_bn as cb
+    if conv.kernel != (1, 1) or conv.use_bias:
+        return False
+    sh, sw = conv.stride
+    if sh != sw:   # fused_pointwise subsamples both dims by one stride
+        return False
+    b, h, w, _ = x.shape
+    h, w = -(-h // sh), -(-w // sw)
+    rows = b * h * w
+    limit = _fused_max_rows()
+    if limit and rows > limit:
+        return False
+    return cb.supports(rows, conv.in_ch, conv.out_ch)
+
+
+def _fold(y, a, b, dt, relu=False, add=None):
+    """The deferred BN epilogue ``relu?(y*a + b (+ add))`` as one
+    elementwise pass in the model dtype (single definition for every
+    fused call site)."""
+    out = y.astype(dt) * a.astype(dt) + b.astype(dt)
+    if add is not None:
+        out = out + add
+    return jax.nn.relu(out) if relu else out
+
+
+def _pointwise_raw_coeffs(conv, bn, conv_params, bn_params, x,
+                          prologue=None):
+    """Fused 1x1 conv via the Pallas kernel: RAW conv output + the
+    FOLLOWING BatchNorm's folded (a, b). ``prologue=(scale, bias,
+    relu?)`` is the PREVIOUS BN's fold, applied on the way into the
+    MXU. Moments come from the kernel epilogue (training) or the EMAs
+    (eval). Shared by ConvBn.raw_coeffs and DenseLayer (one place to
+    fix the stats fold)."""
+    from autodist_tpu.models.core import is_training
+    from autodist_tpu.kernels.conv_bn import fused_pointwise
+    training = is_training()
+    kern = conv_params['kernel'].reshape(conv.in_ch, conv.out_ch)
+    scale, bias, prelu = (None, None, False) if prologue is None \
+        else prologue
+    y, s1, s2 = fused_pointwise(
+        x.astype(conv.dtype), kern, scale=scale, bias=bias,
+        prologue_relu=prelu, want_stats=training,
+        stride=conv.stride[0])
+    if training:
+        n = y.shape[0] * y.shape[1] * y.shape[2]
+        a, b = bn.coeffs_from_moments(bn_params, s1 / n, s2 / n)
+    else:
+        a, b = bn.coeffs(bn_params, y)
+    return y, (a, b)
+
+
 class ConvBn(Module):
     """conv + BN + optional relu — the CNN workhorse."""
 
@@ -137,9 +244,35 @@ class ConvBn(Module):
         return {'conv': self.conv, 'bn': self.bn}
 
     def apply(self, params, x):
+        if _fused_conv_enabled() and _fused_pointwise_ok(self.conv, x):
+            # standalone fused form: the BN stats come from the MXU
+            # epilogue (no stats pass); normalize+relu is one
+            # elementwise pass (XLA-fused)
+            y, (a, b) = self.raw_coeffs(params, x)
+            return _fold(y, a, b, self.conv.dtype, relu=self.relu)
         y = self.bn.apply(params['bn'],
                           self.conv.apply(params['conv'], x))
         return jax.nn.relu(y) if self.relu else y
+
+    # -- fused (deferred-normalize) protocol ------------------------------
+    # raw_coeffs returns the RAW conv output plus this BN's folded
+    # (a, b): the caller applies ``relu?(y*a + b)`` itself — usually by
+    # folding it into the NEXT conv's prologue, so the normalize pass
+    # never touches HBM (kernels/conv_bn.py design note).
+    def raw_coeffs(self, params, x, prologue=None):
+        """``(y_raw, (a, b))``. ``prologue=(scale, bias, relu?)`` is the
+        PREVIOUS BN's fold, applied to ``x`` on the way in. 1x1 convs
+        ride the Pallas fused kernel (BN moments from the epilogue);
+        others take the XLA conv + reduce path."""
+        if _fused_pointwise_ok(self.conv, x):
+            return _pointwise_raw_coeffs(self.conv, self.bn,
+                                         params['conv'], params['bn'],
+                                         x, prologue)
+        if prologue is not None:
+            scale, bias, prelu = prologue
+            x = _fold(x, scale, bias, self.conv.dtype, relu=prelu)
+        y = self.conv.apply(params['conv'], x)
+        return y, self.bn.coeffs(params['bn'], y)
 
 
 # ---------------------------------------------------------------------------
@@ -167,11 +300,34 @@ class Bottleneck(Module):
         return d
 
     def apply(self, params, x):
+        if _fused_conv_enabled() and \
+                _fused_pointwise_ok(self.a.conv, x):
+            return self._apply_fused(params, x)
         sc = x if self.proj is None else self.proj.apply(params['proj'], x)
         y = self.a.apply(params['a'], x)
         y = self.b.apply(params['b'], y)
         y = self.c.apply(params['c'], y)
         return jax.nn.relu(y + sc)
+
+    def _apply_fused(self, params, x):
+        """Bandwidth-lean bottleneck (kernels/conv_bn.py): the two 1x1
+        convs ride the Pallas fused kernel — their BN moments come from
+        the MXU epilogue (no stats pass over the activations) and bn2's
+        normalize+ReLU folds into conv-c's prologue (no apply pass).
+        Remaining full-tensor passes: bn1 apply into the 3x3's input,
+        bn2's stats reduce, and ONE residual-add epilogue."""
+        dt = self.a.conv.dtype
+        y1, (a1, b1) = self.a.raw_coeffs(params['a'], x)
+        y1n = _fold(y1, a1, b1, dt, relu=True)
+        y2, (a2, b2) = self.b.raw_coeffs(params['b'], y1n)
+        y3, (a3, b3) = self.c.raw_coeffs(params['c'], y2,
+                                         prologue=(a2, b2, True))
+        if self.proj is None:
+            sc = x.astype(dt)
+        else:
+            ysc, (asc, bsc) = self.proj.raw_coeffs(params['proj'], x)
+            sc = _fold(ysc, asc, bsc, dt)
+        return _fold(y3, a3, b3, dt, relu=True, add=sc)
 
 
 class ResNet(Module):
@@ -304,10 +460,27 @@ class DenseLayer(Module):
                 'bn2': self.bn2, 'conv2': self.conv2}
 
     def apply(self, params, x):
+        if _fused_conv_enabled() and _fused_pointwise_ok(self.conv1, x):
+            return self._apply_fused(params, x)
         y = self.conv1.apply(params['conv1'], jax.nn.relu(
             self.bn1.apply(params['bn1'], x)))
         y = self.conv2.apply(params['conv2'], jax.nn.relu(
             self.bn2.apply(params['bn2'], y)))
+        return jnp.concatenate([x, y], axis=-1)
+
+    def _apply_fused(self, params, x):
+        """Pre-activation dense layer on the fused kernel: bn1's
+        normalize+ReLU folds into conv1's PROLOGUE (no elementwise pass
+        over the ever-growing concat tensor — DenseNet's dominant
+        activation cost) and bn2's moments come from conv1's epilogue
+        (no stats pass over the bottleneck output)."""
+        dt = self.conv1.dtype
+        a1, b1 = self.bn1.coeffs(params['bn1'], x)
+        y, (a2, b2) = _pointwise_raw_coeffs(
+            self.conv1, self.bn2, params['conv1'], params['bn2'], x,
+            prologue=(a1, b1, True))
+        yn = _fold(y, a2, b2, dt, relu=True)
+        y = self.conv2.apply(params['conv2'], yn)
         return jnp.concatenate([x, y], axis=-1)
 
 

@@ -94,6 +94,23 @@ def _reassemble(own_out, axis_name, pp, share, mb, M, B):
     return out[:B]
 
 
+def _scatter_own(own_out, rank, pp, share, mb, B):
+    """Per-rank [B, ...] layout of this rank's owned outputs (zeros on
+    other ranks' rows): ``psum`` of this across the pipe axis is the
+    reassembled batch. Used so the cross-rank collection happens
+    OUTSIDE the fused schedule's custom_vjp — the trailing psum's own
+    transpose then delivers the full output cotangent to every rank's
+    hand-written backward regardless of the boundary's
+    replicated-output cotangent convention (a custom_vjp that
+    all_gathers internally silently received 1/pp-scaled cotangents
+    under shard_map check_vma=False)."""
+    buf = jnp.zeros((share, pp) + own_out.shape[1:], own_out.dtype)
+    buf = lax.dynamic_update_index_in_dim(
+        buf, own_out, rank, 1)
+    out = buf.reshape((share * pp * mb,) + own_out.shape[2:])
+    return out[:B]
+
+
 def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
     """Run a stage-sharded layer stack as a GPipe pipeline.
 
@@ -229,6 +246,12 @@ def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
         return _fused_1f1b(block_fn, stacked_params, x, axis_name, M,
                            tail_fn, extra, tail_params, head_fn,
                            head_params)
+    if head_fn is not None:
+        # the legacy schedule has no head slot; silently skipping it
+        # would diverge from the pp==1 branch above
+        raise ValueError(
+            'head_fn requires the fused 1F1B mode: pass head_params '
+            '(and tail_params if a tail_fn is used)')
     return _legacy_1f1b(block_fn, stacked_params, x, axis_name, M,
                         tail_fn, extra)
 
@@ -334,6 +357,7 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
         head_params = {}
     if tail_fn is None:
         tail_fn = lambda tp, h, e: h           # noqa: E731
+    have_head = head_fn is not None
     if head_fn is None:
         head_fn = lambda hp, v: v              # noqa: E731
     # extra always present internally (dummy keeps the schedule uniform)
@@ -419,9 +443,10 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
                   jnp.zeros((), jnp.float32))
         (_, _, _, _, own_out, aux_acc), _ = lax.scan(
             step, carry0, jnp.arange(M + pp - 1))
-        out = _reassemble(own_out, axis_name, pp, share, mb, M, B)
-        aux = lax.psum(aux_acc, axis_name) / M
-        return out, aux
+        # PER-RANK partials: the cross-rank psum happens OUTSIDE the
+        # custom_vjp (see _scatter_own)
+        out_part = _scatter_own(own_out, rank, pp, share, mb, B)
+        return out_part, aux_acc
 
     def run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux):
         """Interleaved recompute-forward + backward schedule.
@@ -447,7 +472,10 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
         zero_e = jnp.zeros_like(own_e[0])
         h_shape = jax.eval_shape(lambda v: head_fn(hp, v), zero_x)
         zero_h = jnp.zeros(h_shape.shape, h_shape.dtype)
-        ct_aux_mb = (ct_aux / M).astype(jnp.float32)
+        # the caller-side `psum(aux_part)/M` transpose already applied
+        # the 1/M: the incoming ct IS the per-(microbatch, rank) aux
+        # cotangent
+        ct_aux_mb = ct_aux.astype(jnp.float32)
 
         def stack_fwd(v):
             return stack(sp, v)[0]
@@ -472,8 +500,13 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             # microbatch; others: the incoming activation). The slot
             # being overwritten was consumed at step u-1 (see docstring)
             slot_w = jnp.mod(u, S)
-            stash_x = lax.dynamic_update_index_in_dim(
-                stash_x, reg_x, slot_w, 0)
+            if have_head:
+                # pre-head inputs stashed only when a head exists (for
+                # its re-vjp); without one, stash_h already holds rank
+                # 0's raw input — a second activation-sized stash would
+                # double the advertised pipe-depth bound
+                stash_x = lax.dynamic_update_index_in_dim(
+                    stash_x, reg_x, slot_w, 0)
             stash_h = lax.dynamic_update_index_in_dim(
                 stash_h, inp_h, slot_w, 0)
             # ---- tail vjp at the last rank, same step as chain out ---
@@ -498,16 +531,20 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             slot_r = jnp.mod(u - 2 * (pp - 1) + 2 * rank, S)
             h_in_b = lax.dynamic_index_in_dim(stash_h, slot_r, 0,
                                               keepdims=False)
-            x_in_b = lax.dynamic_index_in_dim(stash_x, slot_r, 0,
-                                              keepdims=False)
 
-            # Rank 0's stashed input is pre-head (tokens); recompute the
-            # head UNCONDITIONALLY on every rank (uniform program — the
-            # head's sharding constraints must not sit in rank-divergent
-            # control flow) and select the effective stack input.
-            head_out_b, head_vjp_fn = jax.vjp(
-                lambda hp_, xv: head_fn(hp_, xv), hp, x_in_b)
-            h_eff = jnp.where(rank == 0, head_out_b, h_in_b)
+            if have_head:
+                # Rank 0's stashed input is pre-head (tokens);
+                # recompute the head UNCONDITIONALLY on every rank
+                # (uniform program — the head's sharding constraints
+                # must not sit in rank-divergent control flow) and
+                # select the effective stack input.
+                x_in_b = lax.dynamic_index_in_dim(stash_x, slot_r, 0,
+                                                  keepdims=False)
+                head_out_b, head_vjp_fn = jax.vjp(
+                    lambda hp_, xv: head_fn(hp_, xv), hp, x_in_b)
+                h_eff = jnp.where(rank == 0, head_out_b, h_in_b)
+            else:
+                h_eff = h_in_b   # rank 0 stashed the raw input itself
 
             def stack_vjp(args):
                 hv, ct = args
@@ -524,7 +561,10 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             ct_head = jnp.where(
                 jnp.logical_and(valid_b, rank == 0), d_h,
                 jnp.zeros_like(d_h))
-            d_hp, d_x = head_vjp_fn(ct_head)
+            if have_head:
+                d_hp, d_x = head_vjp_fn(ct_head)
+            else:
+                d_hp, d_x = g_hp0, ct_head
             ct_prev = d_h
             if x_differentiable:
                 take_dx = jnp.logical_and(valid_b, rank == 0)
@@ -545,22 +585,20 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             return (reg_x, reg_e, state_h, state_e, stash_x, stash_h,
                     ct_reg, g_sp, g_tp, g_hp, dx_buf), None
 
-        stash_x = jnp.zeros((S,) + zero_x.shape, zero_x.dtype)
+        stash_x = jnp.zeros((S,) + zero_x.shape, zero_x.dtype) \
+            if have_head else jnp.zeros((1, 1))
         stash_h = jnp.zeros((S,) + zero_h.shape, zero_h.dtype)
         carry0 = (zero_x, zero_e, zero_h, zero_e, stash_x, stash_h,
                   jnp.zeros_like(zero_h), g_sp0, g_tp0, g_hp0, dx0)
         carry, _ = lax.scan(step, carry0, jnp.arange(T))
         (_, _, _, _, _, _, _, g_sp, g_tp, g_hp, dx_buf) = carry
-        # tail/head params are replicated primals: their cotangent is the
-        # sum of every rank's (masked) contributions
-        g_tp = jax.tree.map(lambda v: lax.psum(v, axis_name), g_tp)
-        g_hp = jax.tree.map(lambda v: lax.psum(v, axis_name), g_hp)
+        # Cotangents are returned as PER-RANK PARTIALS — tail/head
+        # params and x are replicated primals, and the transpose of
+        # replication is a sum: the shard_map boundary psums the
+        # per-rank returns itself. (Psumming here too double-counted;
+        # the direct no-head test pins the 1x scaling.)
         if x_differentiable:
-            # input cotangent materializes only here, at the interface
-            # (rank 0 produced every microbatch's dx; replicate once)
-            dx = lax.psum(
-                jnp.where(rank == 0, dx_buf, jnp.zeros_like(dx_buf)),
-                axis_name)
+            dx = jnp.where(rank == 0, dx_buf, jnp.zeros_like(dx_buf))
             dx = dx.reshape(x_.shape).astype(x_.dtype)
         else:
             dx = zero_ct(x_)
@@ -580,4 +618,10 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
         return run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux)
 
     fused.defvjp(fused_fwd, fused_bwd)
-    return fused(stacked_params, tail_params, head_params, x, extra)
+    out_part, aux_part = fused(stacked_params, tail_params, head_params,
+                               x, extra)
+    # collection outside the custom_vjp: the psum's transpose hands the
+    # backward the FULL output cotangent on every rank
+    out = lax.psum(out_part, axis_name)
+    aux = lax.psum(aux_part, axis_name) / M
+    return out, aux

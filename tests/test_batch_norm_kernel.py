@@ -1,0 +1,68 @@
+"""Hand-scheduled BatchNorm building block (kernels/batch_norm.py):
+single-pass variadic moment reduce + closed-form backward. Kept opt-in
+(the graph-level BN formulation measured equal-or-faster on v5e — see
+models/vision.py BatchNorm.apply note), but exact and available."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.kernels.batch_norm import batch_norm_train, moments
+
+EPS = 1e-5
+
+
+def _ref(x, g, b):
+    mean = jnp.mean(x, (0, 1, 2))
+    var = jnp.mean(jnp.square(x), (0, 1, 2)) - mean * mean
+    return (x - mean) * jax.lax.rsqrt(var + EPS) * g + b
+
+
+def test_forward_and_stats_match_reference():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 5, 6, 16).astype(np.float32))
+    g = jnp.asarray((rng.rand(16) + 0.5).astype(np.float32))
+    b = jnp.asarray(rng.randn(16).astype(np.float32))
+    y, mean, var = batch_norm_train(x, g, b, EPS)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ref(x, g, b)),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(jnp.mean(x, (0, 1, 2))),
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(var),
+        np.asarray(jnp.var(x, (0, 1, 2))), atol=1e-5)
+
+
+def test_closed_form_backward_matches_autodiff():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 4, 4, 8).astype(np.float32))
+    g = jnp.asarray((rng.rand(8) + 0.5).astype(np.float32))
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    ct = jnp.asarray(rng.randn(3, 4, 4, 8).astype(np.float32))
+    grads = jax.grad(
+        lambda *a: jnp.sum(batch_norm_train(*a, EPS)[0] * ct),
+        (0, 1, 2))(x, g, b)
+    want = jax.grad(
+        lambda *a: jnp.sum(_ref(*a) * ct), (0, 1, 2))(x, g, b)
+    for got, ref in zip(grads, want):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_moments_single_pass_and_grad():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 3, 3, 4).astype(np.float32))
+    m1, m2 = moments(x)
+    np.testing.assert_allclose(np.asarray(m1),
+                               np.asarray(jnp.mean(x, (0, 1, 2))),
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(m2), np.asarray(jnp.mean(x * x, (0, 1, 2))),
+        atol=1e-6)
+    got = jax.grad(lambda v: jnp.sum(moments(v)[0] * 0.3) +
+                   jnp.sum(moments(v)[1] * 0.1))(x)
+    ref = jax.grad(lambda v: jnp.sum(jnp.mean(v, (0, 1, 2)) * 0.3) +
+                   jnp.sum(jnp.mean(v * v, (0, 1, 2)) * 0.1))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6)

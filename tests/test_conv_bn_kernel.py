@@ -1,0 +1,218 @@
+"""Pallas fused pointwise-conv + BatchNorm kernel (kernels/conv_bn.py).
+
+The BN-statistics epilogue and normalize+ReLU prologue are the round-4
+answer to the measured ResNet bandwidth ceiling (BASELINE.md: 36% of
+the step was BN moment reductions — one full HBM read per BN site).
+On CPU the kernel runs in Pallas interpret mode — the identical code
+path the TPU executes (same policy as tests/test_flash_attention.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.kernels.conv_bn import fused_pointwise, supports
+from autodist_tpu.models.core import assign_state_paths, model_mode
+from autodist_tpu.models.vision import Bottleneck
+
+
+def _ref(x, w, scale=None, bias=None, prologue_relu=False, stride=1):
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    xf = x.astype(np.float32)
+    if scale is not None:
+        xf = xf * scale + bias
+        if prologue_relu:
+            xf = np.maximum(xf, 0.0)
+    y = xf.reshape(-1, x.shape[-1]) @ w
+    return y, y.sum(0), (y * y).sum(0)
+
+
+def test_supports_gates_on_lanes_and_rows():
+    assert supports(1024, 128, 256)
+    assert supports(1024, 96, 256)         # Cin sublane-aligned is ok
+    assert not supports(1024, 92, 256)     # Cin not sublane-aligned
+    assert not supports(1024, 128, 200)    # Cout not lane-aligned
+    assert not supports(17, 128, 256)      # rows not tileable
+
+
+def test_forward_matches_reference_with_stats():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 4, 128).astype(np.float32)
+    w = (rng.randn(128, 256) * 0.05).astype(np.float32)
+    y, s1, s2 = fused_pointwise(jnp.asarray(x), jnp.asarray(w),
+                                interpret=True)
+    yr, s1r, s2r = _ref(x, w)
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 256), yr,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), s1r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s2), s2r, rtol=1e-5)
+
+
+def test_prologue_and_stride():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 8, 8, 128).astype(np.float32)
+    w = (rng.randn(128, 128) * 0.05).astype(np.float32)
+    a = (rng.rand(128) + 0.5).astype(np.float32)
+    b = (rng.randn(128) * 0.1).astype(np.float32)
+    y, s1, s2 = fused_pointwise(
+        jnp.asarray(x), jnp.asarray(w), scale=jnp.asarray(a),
+        bias=jnp.asarray(b), prologue_relu=True, stride=2,
+        interpret=True)
+    yr, s1r, s2r = _ref(x, w, a, b, True, stride=2)
+    assert y.shape == (2, 4, 4, 128)
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 128), yr,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), s1r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s2), s2r, rtol=1e-5)
+
+
+def test_custom_vjp_matches_autodiff_reference():
+    """The hand-written backward (two MXU matmuls + prologue
+    elementwise) agrees with autodiff of the reference composition for
+    cotangents flowing through y, s1 AND s2."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 4, 4, 128).astype(np.float32))
+    w = jnp.asarray((rng.randn(128, 128) * 0.05).astype(np.float32))
+    a = jnp.asarray((rng.rand(128) + 0.5).astype(np.float32))
+    b = jnp.asarray((rng.randn(128) * 0.1).astype(np.float32))
+
+    def f(x_, w_, a_, b_):
+        y, s1, s2 = fused_pointwise(x_, w_, scale=a_, bias=b_,
+                                    prologue_relu=True, interpret=True)
+        return jnp.sum(y * 0.3) + jnp.sum(s1 * 0.1) + jnp.sum(s2 * 0.01)
+
+    def fref(x_, w_, a_, b_):
+        xn = jnp.maximum(x_ * a_ + b_, 0).reshape(-1, 128)
+        y = xn @ w_
+        return jnp.sum(y * 0.3) + jnp.sum(jnp.sum(y, 0) * 0.1) + \
+            jnp.sum(jnp.sum(y * y, 0) * 0.01)
+
+    g = jax.grad(f, argnums=(0, 1, 2, 3))(x, w, a, b)
+    gr = jax.grad(fref, argnums=(0, 1, 2, 3))(x, w, a, b)
+    for got, want in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
+
+@pytest.fixture
+def _fused_env(monkeypatch):
+    monkeypatch.setenv('AUTODIST_FUSED_CONV', '1')
+    yield
+    monkeypatch.setenv('AUTODIST_FUSED_CONV', '0')
+
+
+def _bottleneck_run(blk, params, x, fused):
+    os.environ['AUTODIST_FUSED_CONV'] = '1' if fused else '0'
+
+    def loss(p):
+        with model_mode(training=True) as mm:
+            y = blk.apply(p, x)
+        return jnp.mean(y ** 2), dict(mm.updates)
+
+    (l, upd), g = jax.value_and_grad(loss, has_aux=True)(params)
+    return l, g, upd
+
+
+def test_fused_bottleneck_matches_unfused(_fused_env):
+    """Full ResNet bottleneck (both 1x1 convs on the kernel, bn2 apply
+    folded into conv-c's prologue, projection shortcut fused): loss,
+    every gradient, and every EMA state update match the sequential
+    conv/BN path; eval mode (EMA stats) matches too."""
+    blk = Bottleneck(128, 128, stride=2, dtype=jnp.float32)
+    assign_state_paths(blk)
+    params = blk.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 8, 128).astype(np.float32))
+    l0, g0, u0 = _bottleneck_run(blk, params, x, fused=False)
+    l1, g1, u1 = _bottleneck_run(blk, params, x, fused=True)
+    assert np.isclose(float(l0), float(l1), atol=1e-6)
+    for got, want in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+    assert set(u0) == set(u1) and len(u0) == 8   # 4 BNs x (mean, var)
+    for k in u0:
+        np.testing.assert_allclose(np.asarray(u1[k]), np.asarray(u0[k]),
+                                   atol=1e-5)
+    os.environ['AUTODIST_FUSED_CONV'] = '0'
+    with model_mode(training=False):
+        ye0 = blk.apply(params, x)
+    os.environ['AUTODIST_FUSED_CONV'] = '1'
+    with model_mode(training=False):
+        ye1 = blk.apply(params, x)
+    np.testing.assert_allclose(np.asarray(ye1), np.asarray(ye0),
+                               atol=1e-5)
+
+
+def test_identity_shortcut_bottleneck(_fused_env):
+    """stride-1 identity-shortcut block (the 23-deep ResNet-101 stage-3
+    shape class) takes the fused path and matches."""
+    blk = Bottleneck(512, 128, stride=1, dtype=jnp.float32)
+    assign_state_paths(blk)
+    params = blk.init(jax.random.PRNGKey(3))
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 4, 4, 512).astype(np.float32))
+    l0, g0, _ = _bottleneck_run(blk, params, x, fused=False)
+    l1, g1, _ = _bottleneck_run(blk, params, x, fused=True)
+    assert np.isclose(float(l0), float(l1), atol=1e-6)
+    for got, want in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+def test_dense_layer_fused_matches(_fused_env):
+    """DenseNet pre-activation layer: bn1's normalize+ReLU in conv1's
+    prologue, bn2's moments from conv1's epilogue. in_ch=96 exercises
+    the sublane-aligned (non-128) contraction gate."""
+    from autodist_tpu.models.vision import DenseLayer
+    layer = DenseLayer(96, 32, dtype=jnp.float32)
+    assign_state_paths(layer)
+    params = layer.init(jax.random.PRNGKey(7))
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(2, 4, 4, 96).astype(np.float32))
+    l0, g0, u0 = _bottleneck_run(layer, params, x, fused=False)
+    l1, g1, u1 = _bottleneck_run(layer, params, x, fused=True)
+    assert np.isclose(float(l0), float(l1), atol=1e-6)
+    for got, want in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+    for k in u0:
+        np.testing.assert_allclose(np.asarray(u1[k]), np.asarray(u0[k]),
+                                   atol=1e-5)
+
+
+def test_standalone_convbn_fused_matches(_fused_env):
+    """ConvBn.apply's fused branch (DenseNet transitions, Inception 1x1
+    towers): stats from the epilogue, one elementwise normalize."""
+    from autodist_tpu.models.vision import ConvBn
+    m = ConvBn(256, 128, 1, 1, dtype=jnp.float32)
+    assign_state_paths(m)
+    params = m.init(jax.random.PRNGKey(9))
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(2, 4, 4, 256).astype(np.float32))
+    l0, g0, _ = _bottleneck_run(m, params, x, fused=False)
+    l1, g1, _ = _bottleneck_run(m, params, x, fused=True)
+    assert np.isclose(float(l0), float(l1), atol=1e-6)
+    for got, want in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+def test_narrow_channels_fall_back(_fused_env):
+    """Stage-1 blocks: the 64-output convs fall back to the sequential
+    path (Cout not lane-aligned), the 64->256 expansion still rides the
+    kernel — the mixed block agrees with the flag off."""
+    blk = Bottleneck(64, 64, stride=1, dtype=jnp.float32)
+    assign_state_paths(blk)
+    params = blk.init(jax.random.PRNGKey(5))
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(2, 4, 4, 64).astype(np.float32))
+    l0, g0, _ = _bottleneck_run(blk, params, x, fused=False)
+    l1, g1, _ = _bottleneck_run(blk, params, x, fused=True)
+    assert np.isclose(float(l0), float(l1), atol=1e-6)
+    for got, want in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)

@@ -100,6 +100,72 @@ def test_pipeline_1f1b_ragged_microbatches(batch):
     assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
 
 
+def test_fused_1f1b_direct_no_head():
+    """Direct pipeline API, fused mode WITHOUT a head (float x enters
+    the pipe, loss folded in the tail): gradients for blocks, tail
+    params, and x itself match the single-stage (pp=1) reference. This
+    is the stash_h-only backward path (no pre-head stash)."""
+    from autodist_tpu.parallel.pipeline import one_f_one_b
+
+    pp, M, mb, dim = 2, 4, 2, 8
+    B = M * mb
+    rng = np.random.RandomState(0)
+    sp = {'w': jnp.asarray(rng.randn(pp, 2, dim, dim).astype('f4') / 4)}
+    tp = {'out': jnp.asarray(rng.randn(dim).astype('f4'))}
+    x = jnp.asarray(rng.randn(B, dim).astype('f4'))
+    tgt = jnp.asarray(rng.randint(0, 2, (B, 1)).astype(np.int32))
+
+    def block_fn(p, h):
+        return jnp.tanh(h @ p), jnp.zeros((), jnp.float32)
+
+    def tail_fn(tpp, h, e):
+        # per-mb scalar-ish output with leading mb dim
+        return (h @ tpp['out'])[:, None] * (1.0 + e.astype(h.dtype))
+
+    def run(n_stages):
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:n_stages]), ('pipe',))
+
+        def loss(sp_, tp_, x_):
+            def inner(sp__, tp__, x__, tgt_):
+                # local shard of the stage-stacked params: [1, L, ...]
+                out, _ = one_f_one_b(
+                    block_fn, sp__['w'][0], x__, 'pipe', M,
+                    tail_fn=tail_fn, extra=tgt_, tail_params=tp__)
+                return out
+
+            mapped = jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=({'w': P('pipe')}, P(), P(), P()),
+                out_specs=P(), axis_names={'pipe'}, check_vma=False)
+            # reduce OUTSIDE the region (replicated-out cotangent is
+            # then unambiguous)
+            return jnp.sum(mapped(sp_, tp_, x_, tgt)
+                           .astype(jnp.float32) ** 2)
+
+        # under jit like every real caller (eager shard_map transpose
+        # uses a different unreduced-cotangent convention)
+        return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+            sp, tp, x)
+
+    # pp=1 reference path via plain composition
+    def ref_loss(sp_, tp_, x_):
+        h = x_
+        for s in range(pp):
+            for l in range(2):
+                h, _ = block_fn(sp_['w'][s, l], h)
+        out = tail_fn(tp_, h, tgt)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ref_val, ref_g = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        sp, tp, x)
+    val, g = run(pp)
+    assert np.isclose(float(val), float(ref_val), rtol=1e-5)
+    for got, want in zip(jax.tree.leaves(g), jax.tree.leaves(ref_g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
+
 def test_pipeline_1f1b_reduces_peak_memory():
     """The point of 1F1B: the custom-vjp backward interleaves
     recompute-forwards and backwards with a 2(pp-1)+1-slot circular
