@@ -163,6 +163,56 @@ def bench_resnet101(n, steps, on_tpu):
     return ips_chip, ips_chip * RESNET101_TRAIN_FLOPS_PER_IMG, xla_flops
 
 
+def bench_sparse(steps):
+    """The reference's sparse benchmark family (examples/benchmark/
+    ncf.py + examples/lm1b): NCF at ml-20m scale with PSLoadBalancing,
+    LM1B LSTM with PartitionedPS embeddings (BASELINE.json configs)."""
+    import jax
+    import optax
+
+    from autodist_tpu import strategy as strategies
+    from autodist_tpu.models.ncf import NCF
+    from autodist_tpu.strategy.adapter import trainer_from_strategy
+
+    rng = np.random.RandomState(0)
+    out = {}
+
+    model = NCF(138493, 26744, mf_dim=64, mlp_dims=(256, 128, 64))
+    trainer = trainer_from_strategy(model, optax.adam(1e-3),
+                                    strategies.PSLoadBalancing())
+    state = trainer.init(jax.random.PRNGKey(0))
+    batch = {'users': rng.randint(0, 138493, (4096,), dtype=np.int32),
+             'items': rng.randint(0, 26744, (4096,), dtype=np.int32),
+             'labels': rng.randint(0, 2, (4096,), dtype=np.int32)}
+    compiled = trainer.compile_step(state, batch)
+    batch = trainer.shard_batch(batch)
+    state, m = compiled(state, batch)
+    float(m['loss'])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = compiled(state, batch)
+    float(m['loss'])
+    out['ncf'] = 4096 * steps / (time.perf_counter() - t0)
+
+    from autodist_tpu.models.rnn import LSTMLM
+    model = LSTMLM(vocab=100000, dim=512, hidden=1024, n_layers=2)
+    trainer = trainer_from_strategy(model, optax.adam(1e-3),
+                                    strategies.PartitionedPS())
+    state = trainer.init(jax.random.PRNGKey(0))
+    toks = rng.randint(0, 100000, (128, 33), dtype=np.int32)
+    batch = {'tokens': toks[:, :-1], 'targets': toks[:, 1:]}
+    compiled = trainer.compile_step(state, batch)
+    batch = trainer.shard_batch(batch)
+    state, m = compiled(state, batch)
+    float(m['loss'])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = compiled(state, batch)
+    float(m['loss'])
+    out['lm1b'] = 128 * 32 * steps / (time.perf_counter() - t0)
+    return out
+
+
 def bench_longctx(steps):
     """Long-context training point: gpt_small at seq 4096 through the
     Pallas flash-attention path (3.4x over XLA attention at this length
@@ -271,6 +321,7 @@ def main():
     bert_tps, bert_fps, bert_xla = bench_bert(n, steps, on_tpu)
     img_ps, rn_fps, rn_xla = bench_resnet101(n, steps, on_tpu)
     longctx_tps = bench_longctx(10) if on_tpu else None
+    sparse = bench_sparse(steps) if on_tpu else None
 
     if on_tpu:
         result = {
@@ -287,6 +338,9 @@ def main():
                 'resnet101_mfu_pct': mfu_pct(rn_fps, peak),
                 'longctx_gpt_small_s4096_tokens_per_sec_per_chip':
                     round(longctx_tps, 1),
+                'ncf_examples_per_sec_per_chip': round(sparse['ncf'], 1),
+                'lm1b_lstm_tokens_per_sec_per_chip':
+                    round(sparse['lm1b'], 1),
                 'xla_cost_flops_per_step': {
                     'bert': bert_xla, 'resnet101': rn_xla},
                 'device_kind': str(getattr(dev, 'device_kind', '')),

@@ -701,6 +701,10 @@ def test_four_worker_loose_100mb_two_endpoints(tmp_path):
         assert r['ps_mb'] > 400, r    # 2 steps x (pull+push) x 105 MB
     # aggregate service throughput across 4 workers (recorded for
     # BASELINE.md): must beat a single worker's floor
+    print('\n4-worker PS aggregate: %.0f MB over %.1f s -> %.0f MB/s '
+          '(per-worker %s MB/s)' %
+          (agg_mb, agg_s, agg_mb / agg_s,
+           [round(r['ps_mb_per_s']) for r in results]))
     assert agg_mb / agg_s > 40, (agg_mb, agg_s)
 
 
