@@ -80,7 +80,15 @@ def collective_bytes(compiled):
     tuple-result collectives — the program-level gradient-group fusion
     — sum their elements). This is the auditable per-step wire
     accounting the scaling bench reports; the compiled program is the
-    ground truth."""
+    ground truth.
+
+    Caveats (same class as compiled_step_flops' while-loop note): a
+    collective INSIDE an HLO while body (e.g. per-layer tp psums or
+    pipeline ppermutes under scan_layers) is counted once, not once per
+    iteration — the dp gradient all-reduces this is used for sit
+    outside the scan. Unknown result dtypes are counted at 4 B and
+    reported under an 'unknown_dtypes' key rather than guessed
+    silently."""
     kind_re = _COLLECTIVE_RE
     shape_re = _SHAPE_RE
     out = {}
@@ -95,6 +103,10 @@ def collective_bytes(compiled):
             continue
         total = 0
         for dtype, dims in shape_re.findall(line[eq + 3:m.start()]):
+            if dtype not in _DTYPE_BYTES:
+                out.setdefault('unknown_dtypes', [])
+                if dtype not in out['unknown_dtypes']:
+                    out['unknown_dtypes'].append(dtype)
             size = _DTYPE_BYTES.get(dtype, 4)
             for d in filter(None, dims.split(',')):
                 size *= int(d)
@@ -340,8 +352,11 @@ def bench_scaling(steps=5):
     # bytes are exact for any backend): gpt-small at dp=n. On TPU the
     # timed workload above IS gpt-small, so reuse its accounting
     # instead of paying a duplicate multi-minute compile.
-    real_comm = dict(comm.get(n, {}))   # on TPU the timed workload IS
-    if not on_tpu:                      # gpt-small; reuse its numbers
+    if on_tpu:
+        # the timed workload above IS gpt-small: reuse its numbers
+        real_comm = dict(comm.get(n, {}))
+    else:
+        real_comm = {}   # never mislabel the tiny-LM bytes on failure
         try:
             import optax
 
@@ -358,6 +373,10 @@ def bench_scaling(steps=5):
             real_comm = collective_bytes(tr.compile_step(st, rb))
         except Exception:   # noqa: BLE001 - accounting is best-effort
             pass
+    # a dp=1 program must compile with ZERO collectives — a lowering
+    # regression here should fail the bench, not pass silently
+    assert not comm.get(1), 'dp=1 program emitted collectives: %r' % (
+        comm.get(1),)
     return {
         'metric': 'dp_scaling_tokens_per_sec_per_chip',
         'value': round(tpsn, 1),
