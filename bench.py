@@ -87,7 +87,7 @@ def collective_bytes(compiled):
     pipeline ppermutes under scan_layers) is counted once, not once per
     iteration — the dp gradient all-reduces this is used for sit
     outside the scan. Unknown result dtypes are counted at 4 B and
-    reported under an 'unknown_dtypes' key rather than guessed
+    counted under an 'unknown_dtype_shapes' tally rather than guessed
     silently."""
     kind_re = _COLLECTIVE_RE
     shape_re = _SHAPE_RE
@@ -104,9 +104,11 @@ def collective_bytes(compiled):
         total = 0
         for dtype, dims in shape_re.findall(line[eq + 3:m.start()]):
             if dtype not in _DTYPE_BYTES:
-                out.setdefault('unknown_dtypes', [])
-                if dtype not in out['unknown_dtypes']:
-                    out['unknown_dtypes'].append(dtype)
+                # distinctly-typed sentinel key (count of shapes whose
+                # dtype was guessed at 4 B) — keeps every BYTES value an
+                # int keyed by collective kind
+                out['unknown_dtype_shapes'] = \
+                    out.get('unknown_dtype_shapes', 0) + 1
             size = _DTYPE_BYTES.get(dtype, 4)
             for d in filter(None, dims.split(',')):
                 size *= int(d)
@@ -345,6 +347,10 @@ def bench_scaling(steps=5):
                              spec=ParallelSpec(dp=dp), stats_out=stats)
         times[dp] = (dt, batch_size * seq * steps / dt / dp)
         comm[dp] = stats.get('collective_bytes', {})
+    # a dp=1 program must compile with ZERO collectives — fail fast,
+    # before the (expensive) realistic-shape accounting below
+    assert not comm.get(1), 'dp=1 program emitted collectives: %r' % (
+        comm.get(1),)
     t1, tps1 = times[1]
     tn, tpsn = times[n]
     # realistic-shape wire accounting (compile-only — the CPU mesh
@@ -373,10 +379,6 @@ def bench_scaling(steps=5):
             real_comm = collective_bytes(tr.compile_step(st, rb))
         except Exception:   # noqa: BLE001 - accounting is best-effort
             pass
-    # a dp=1 program must compile with ZERO collectives — a lowering
-    # regression here should fail the bench, not pass silently
-    assert not comm.get(1), 'dp=1 program emitted collectives: %r' % (
-        comm.get(1),)
     return {
         'metric': 'dp_scaling_tokens_per_sec_per_chip',
         'value': round(tpsn, 1),

@@ -487,6 +487,28 @@ def test_loose_mode_carries_100mb_model_multi_endpoint(tmp_path):
 
 
 @pytest.mark.integration
+def test_authenticated_loose_mode_end_to_end(tmp_path, monkeypatch):
+    """AUTODIST_COORD_TOKEN through the full loose stack: the chief
+    starts the service WITH the secret in its env, every process (and
+    the background heartbeat threads' own connections) answers the
+    nonce challenge, and training behaves identically to the open
+    service — plus staleness semantics still hold."""
+    # also in THIS process's env so launch_pair's teardown client can
+    # authenticate its SHUTDOWN (else the service would leak)
+    monkeypatch.setenv('AUTODIST_COORD_TOKEN', 'integration-secret-42')
+    body = STALENESS_BODY % {'builder_kwargs': 'staleness=3'}
+    results = launch_pair(
+        tmp_path, body, timeout=420,
+        extra_env={'AUTODIST_COORD_TOKEN': 'integration-secret-42'})
+    chief = next(r for r in results if r['role'] == 'chief')
+    assert max(chief['lead']) <= 3, chief['lead']
+    # the authed plane must not degrade run-ahead into lock-step
+    assert max(chief['lead']) >= 2, chief['lead']
+    for r in results:
+        assert abs(r['b']) > 1e-4
+
+
+@pytest.mark.integration
 def test_bf16_wire_end_to_end(tmp_path):
     """AUTODIST_PS_WIRE_DTYPE=bf16 halves the PS wire; training still
     converges through the quantized frames (values f32 at rest)."""
