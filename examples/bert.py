@@ -31,6 +31,11 @@ def main():
                    choices=['ring', 'ulysses'])
     p.add_argument('--zero', type=int, default=1)
     p.add_argument('--microbatches', type=int, default=1)
+    p.add_argument('--pp-schedule', default='gpipe',
+                   choices=['gpipe', '1f1b'],
+                   help="'1f1b': custom-vjp interleaved schedule — live "
+                        'activations bounded by the pipe depth '
+                        '(embed/head folded into the first/last stages)')
     p.add_argument('--grad-accum', type=int, default=1)
     p.add_argument('--fp32', action='store_true')
     args = p.parse_args()
@@ -54,6 +59,7 @@ def main():
     spec = ParallelSpec(dp=args.dp, tp=args.tp, pp=args.pp, sp=args.sp,
                         sp_mode=args.sp_mode, zero=args.zero,
                         microbatches=args.microbatches,
+                        pp_schedule=args.pp_schedule,
                         grad_accum=args.grad_accum)
     trainer = Trainer(model, opt, spec=spec)
     state = trainer.init(jax.random.PRNGKey(0))
