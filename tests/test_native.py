@@ -393,12 +393,19 @@ def test_coord_service_auth_handshake(monkeypatch, tmp_path):
             proc.wait(timeout=5)
 
 
-def test_loose_partitioned_get_load_roundtrip(coord, monkeypatch):
+@pytest.mark.parametrize('builder_name,rows,shard_sizes', [
+    ('PartitionedPS', 6, [3, 3]),          # even split
+    ('UnevenPartitionedPS', 7, [4, 3]),    # np.array_split semantics
+])
+def test_loose_partitioned_get_load_roundtrip(coord, monkeypatch,
+                                              builder_name, rows,
+                                              shard_sizes):
     """Single-process loose session over a PARTITIONED variable: the
     shard-keyed data plane serves get_variable_value (merge) and
     load_variable_value (split) exactly — the save/restore path of the
     per-shard placement (reference rebuilds savers over
-    PartitionedVariables, kernel/partitioner.py:251-347)."""
+    PartitionedVariables, kernel/partitioner.py:251-347), including
+    UNEVEN shard sizes (uneven_partition_ps_strategy.py:125-133)."""
     import autodist_tpu as ad
     from autodist_tpu import autodist as ad_mod
     ad_mod._DEFAULT_AUTODIST.clear()
@@ -406,26 +413,28 @@ def test_loose_partitioned_get_load_roundtrip(coord, monkeypatch):
     monkeypatch.setenv('AUTODIST_COORD_SERVICE_ADDR',
                        '%s:%d' % (host, port))
     monkeypatch.setenv('AUTODIST_NUM_PROCESSES', '1')
+    builder = getattr(ad.strategy, builder_name)(staleness=1)
     autodist = ad.AutoDist(
         resource_info={'nodes': [
             {'address': 'localhost', 'gpus': [0], 'chief': True,
              'network_bandwidth': 100}]},
-        strategy_builder=ad.strategy.PartitionedPS(staleness=1))
+        strategy_builder=builder)
     rng = np.random.RandomState(0)
-    W0 = rng.randn(6, 3).astype(np.float32)   # 6 rows -> 2 shards
+    W0 = rng.randn(rows, 3).astype(np.float32)
     with autodist.scope():
-        x = ad.placeholder(shape=[None, 6], dtype=np.float32, name='x')
+        x = ad.placeholder(shape=[None, rows], dtype=np.float32,
+                           name='x')
         W = ad.Variable(W0, name='W')
         loss = ad.ops.reduce_mean(ad.ops.square(ad.ops.matmul(x, W)))
         train_op = ad.optimizers.SGD(0.1).minimize(loss, [W])
         sess = autodist.create_distributed_session()
-        # partitioned: the authoritative copy is shard-keyed
-        assert sess._plan.var_plans['W'].num_shards == 2
+        plan = sess._plan.var_plans['W']
+        assert plan.num_shards == len(shard_sizes)
+        assert plan.part_config.shard_sizes(rows) == shard_sizes
         np.testing.assert_allclose(sess.get_variable_value('W'), W0,
                                    atol=1e-6)
-        sess.run(train_op, {x: rng.randn(4, 6).astype(np.float32)})
-        moved = sess.get_variable_value('W')
-        assert np.abs(moved - W0).max() > 1e-6
+        sess.run(train_op, {x: rng.randn(4, rows).astype(np.float32)})
+        assert np.abs(sess.get_variable_value('W') - W0).max() > 1e-6
         # checkpoint-restore path: load splits across the shards
         sess.load_variable_value('W', W0)
         np.testing.assert_allclose(sess.get_variable_value('W'), W0,
