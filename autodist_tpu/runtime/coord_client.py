@@ -143,14 +143,23 @@ def ps_endpoints():
     return eps
 
 
-def connect_with_retry(address=None, deadline_s=30.0):
+def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
     """Connect to the coord service, retrying until it comes up (workers
-    may start before the chief's ensure_service)."""
+    may start before the chief's ensure_service).
+
+    Connection attempts stay snappy (5 s), but the ESTABLISHED client
+    gets ``op_timeout`` per socket operation: data-plane transfers move
+    multi-MB frames through per-tensor locks under contention, and a
+    single 64 KB recv stalling past a short probe timeout would kill a
+    healthy pull (observed as a flaky 4-worker x 105 MB test on a
+    loaded one-core host). Callers that need FAST failure detection on
+    an established connection (e.g. heartbeat loops) pass a small
+    ``op_timeout`` instead."""
     deadline = time.time() + deadline_s
     last = None
     while time.time() < deadline:
         try:
-            c = CoordClient(address, timeout=5.0)
+            c = CoordClient(address, timeout=5.0, op_timeout=op_timeout)
             c.ping()
             return c
         except OSError as e:
@@ -163,7 +172,7 @@ def connect_with_retry(address=None, deadline_s=30.0):
 class CoordClient:
     """Blocking line-protocol client."""
 
-    def __init__(self, address=None, timeout=None):
+    def __init__(self, address=None, timeout=None, op_timeout=None):
         if address is None:
             raw = ENV.AUTODIST_COORD_SERVICE_ADDR.val
             if raw:
@@ -179,6 +188,12 @@ class CoordClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b''
         self._handshake()
+        # per-operation timeout for the ESTABLISHED connection (the
+        # connect `timeout` stays snappy for probes/handshake); the
+        # timed waits below temporarily override and RESTORE it
+        self._op_timeout = op_timeout if op_timeout is not None \
+            else timeout
+        self._sock.settimeout(self._op_timeout)
 
     def _read_reply_line(self):
         while b'\n' not in self._buf:
@@ -277,26 +292,37 @@ class CoordClient:
         resp = self._rpc('INCR %s %d' % (key, delta))
         return int(resp[4:])
 
-    def wait_ge(self, key, n, timeout_s=60.0):
+    def _timed_rpc(self, line, timeout_s):
+        """RPC under a wait-specific socket timeout, RESTORING the
+        client's op timeout after — a gate's short slice must not
+        clobber the generous data-plane timeout for the next multi-MB
+        pull on the same socket."""
         self._sock.settimeout(timeout_s + 5.0)
-        resp = self._rpc('WAITGE %s %d %d' % (key, n,
-                                              int(timeout_s * 1000)))
+        try:
+            return self._rpc(line)
+        finally:
+            self._sock.settimeout(self._op_timeout)
+
+    def wait_ge(self, key, n, timeout_s=60.0):
+        resp = self._timed_rpc('WAITGE %s %d %d'
+                               % (key, n, int(timeout_s * 1000)),
+                               timeout_s)
         if resp == 'TIMEOUT':
             raise TimeoutError('wait_ge(%s, %d)' % (key, n))
         return int(resp[4:])
 
     def min_wait(self, prefix, n, k, timeout_s=60.0):
-        self._sock.settimeout(timeout_s + 5.0)
-        resp = self._rpc('MINWAIT %s %d %d %d' %
-                         (prefix, n, k, int(timeout_s * 1000)))
+        resp = self._timed_rpc('MINWAIT %s %d %d %d'
+                               % (prefix, n, k, int(timeout_s * 1000)),
+                               timeout_s)
         if resp == 'TIMEOUT':
             raise TimeoutError('min_wait(%s, %d)' % (prefix, n))
         return int(resp[4:])
 
     def barrier(self, name, parties, timeout_s=60.0):
-        self._sock.settimeout(timeout_s + 5.0)
-        resp = self._rpc('BARRIER %s %d %d' %
-                         (name, parties, int(timeout_s * 1000)))
+        resp = self._timed_rpc('BARRIER %s %d %d'
+                               % (name, parties, int(timeout_s * 1000)),
+                               timeout_s)
         if resp == 'TIMEOUT':
             raise TimeoutError('barrier(%s, %d)' % (name, parties))
 

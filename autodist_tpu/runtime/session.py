@@ -206,8 +206,14 @@ class Session:
                     while not stop.is_set():
                         if client is None:
                             try:
+                                # SHORT op timeout: a half-open socket
+                                # must surface within the heartbeat
+                                # window (not the generous data-plane
+                                # timeout) so the loop reconnects and
+                                # keeps beating
                                 client = connect_with_retry(
-                                    coord_addr, deadline_s=interval)
+                                    coord_addr, deadline_s=interval,
+                                    op_timeout=min(10.0, interval))
                             except Exception:  # noqa: BLE001 - advisory
                                 if not warned:
                                     warned = True
