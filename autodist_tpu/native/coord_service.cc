@@ -71,7 +71,10 @@
 //   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
 //   BSET <key> <nbytes> <wire> [<off> <total>]  [payload] -> OK
 //       (store tensor; wire dtype f32|bf16, stored as f32)
-//   BGET <key> <wire> [<off> <count>] -> VAL <nbytes>\n[payload] | NONE
+//   BGET <key> <wire> [<off> <count>] [v] -> VAL <nbytes> [<ver>]\n
+//       [payload] | NONE   ("v" opts into <ver> = version*2 +
+//        write_in_progress; odd or chunk-to-chunk-changing ver = torn
+//        read, client retries)
 //   BADD <key> <nbytes> <wire> [<off> <total>]  [payload] -> VAL <n>
 //       (atomic elementwise += ; creates the tensor if absent; returns
 //        the tensor's accumulated push count)
@@ -98,6 +101,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -120,6 +124,20 @@ struct Tensor {
   std::vector<float> slot2;  // adam second moment / adagrad accumulator
   int64_t pushes = 0;
   int64_t steps = 0;  // BSTEP optimizer-step counter (adam bias t)
+  // Torn-read detection (ADVICE r4).  `version` bumps on every
+  // mutating frame (each BSET chunk, BADD, BSTEP); `open_writes`
+  // counts chunked write sequences in flight (first chunk ++, final
+  // chunk --, so a single whole-tensor frame nets 0 inside its own
+  // lock hold).  A BGET that opts in (trailing "v") gets
+  // `version*2 + (open_writes>0)` in its reply: an odd value or a
+  // value that moves across a reader's chunks means the read raced a
+  // writer and must be retried.  Every error reply closes the sequence
+  // it opened (abort), so a rejected write cannot wedge the counter; a
+  // writer that dies silently mid-sequence leaves it stuck until DELNS
+  // removes the tensor — readers surface that as a stalled-odd error
+  // rather than torn data.
+  int64_t version = 0;
+  int64_t open_writes = 0;
 };
 
 struct Store {
@@ -263,17 +281,29 @@ std::string to_hex(const uint8_t* p, size_t n) {
   return out;
 }
 
+// Returns 32 hex chars of OS entropy, or "" when no unpredictable
+// source exists.  NO predictable fallback (ADVICE r4): a clock+pid
+// nonce makes the HMAC challenge replayable, so the caller must fail
+// closed (refuse the authenticated connection) on "".
 std::string make_nonce() {
   uint8_t raw[16];
+  size_t got = 0;
   FILE* f = fopen("/dev/urandom", "rb");
-  size_t got = f ? fread(raw, 1, sizeof(raw), f) : 0;
-  if (f) fclose(f);
-  if (got != sizeof(raw)) {  // degraded fallback: clock + counter
-    static std::atomic<uint64_t> ctr{0};
-    uint64_t a = std::chrono::steady_clock::now().time_since_epoch().count();
-    uint64_t b = ++ctr + (uint64_t)getpid();
-    memcpy(raw, &a, 8);
-    memcpy(raw + 8, &b, 8);
+  if (f) {
+    got = fread(raw, 1, sizeof(raw), f);
+    fclose(f);
+  }
+  if (got != sizeof(raw)) {
+    try {
+      std::random_device rd;  // getrandom()/RDRAND-backed on Linux
+      for (size_t i = 0; i < sizeof(raw); i += 4) {
+        uint32_t v = rd();
+        memcpy(raw + i, &v, sizeof(v));
+      }
+      got = sizeof(raw);
+    } catch (...) {
+      return "";
+    }
   }
   return to_hex(raw, sizeof(raw));
 }
@@ -531,6 +561,16 @@ std::string handle(const std::string& line, const std::string& payload,
       return "ERR bad range";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
+    // open the write sequence FIRST; every later return (error =
+    // abort, final chunk = complete) closes it, so the counter can't
+    // wedge and concurrent writers' counts are never clobbered (++,
+    // not =1: another sequence's final-chunk decrement must not zero
+    // the flag while this reset is still mid-flight).
+    if (off == 0) ++t->open_writes;
+    auto fail = [&](const char* e) {
+      if (t->open_writes > 0) --t->open_writes;
+      return std::string(e);
+    };
     if (off == 0) {  // a (re)set starts at its first chunk
       t->data.assign(total, 0.f);
       t->slot1.clear();
@@ -538,8 +578,11 @@ std::string handle(const std::string& line, const std::string& payload,
       t->pushes = 0;
       t->steps = 0;
     }
-    if (t->data.size() != total) return "ERR shape mismatch";
+    if (t->data.size() != total) return fail("ERR shape mismatch");
     std::copy(vals.begin(), vals.end(), t->data.begin() + off);
+    if (off + vals.size() >= total && t->open_writes > 0)
+      --t->open_writes;
+    ++t->version;
     return "OK";
   }
   if (cmd == "BSTAT") {
@@ -561,13 +604,19 @@ std::string handle(const std::string& line, const std::string& payload,
     std::string k, wire;
     in >> k >> wire;
     if (wire.empty()) wire = "f32";
+    // optional trailing "v" (after the optional range) opts in to a
+    // version field in the reply — old clients keep the old format
+    int64_t o = -1, c = -1;
+    bool have_range = static_cast<bool>(in >> o >> c);
+    in.clear();
+    std::string flag;
+    bool want_ver = static_cast<bool>(in >> flag) && flag == "v";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "NONE";
     {
       std::lock_guard<std::mutex> l(t->mu);
       size_t off = 0, count = t->data.size();
-      int64_t o = -1, c = -1;
-      if (in >> o >> c) {
+      if (have_range) {
         if (o < 0 || c < 0 ||
             static_cast<size_t>(o) + static_cast<size_t>(c) >
                 t->data.size())
@@ -577,8 +626,12 @@ std::string handle(const std::string& line, const std::string& payload,
       }
       if (!encode_wire(t->data.data() + off, count, wire, reply_payload))
         return "ERR bad wire dtype";
+      std::string resp = "VAL " + std::to_string(reply_payload->size());
+      if (want_ver)
+        resp += " " + std::to_string(t->version * 2 +
+                                     (t->open_writes > 0 ? 1 : 0));
+      return resp;
     }
-    return "VAL " + std::to_string(reply_payload->size());
   }
   if (cmd == "BADD") {
     std::string k, wire;
@@ -591,11 +644,19 @@ std::string handle(const std::string& line, const std::string& payload,
       return "ERR bad range";
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
+    if (off == 0) ++t->open_writes;  // open first; abort/final closes
+    auto fail = [&](const char* e) {
+      if (t->open_writes > 0) --t->open_writes;
+      return std::string(e);
+    };
     if (t->data.empty()) t->data.assign(total, 0.f);
-    if (t->data.size() != total) return "ERR shape mismatch";
+    if (t->data.size() != total) return fail("ERR shape mismatch");
+    if (off == 0) ++t->pushes;  // one logical push counts once
     for (size_t i = 0; i < delta.size(); ++i)
       t->data[off + i] += delta[i];
-    if (off == 0) ++t->pushes;  // one logical push counts once
+    if (off + delta.size() >= total && t->open_writes > 0)
+      --t->open_writes;
+    ++t->version;
     return "VAL " + std::to_string(t->pushes);
   }
   if (cmd == "BSTEP") {
@@ -612,10 +673,15 @@ std::string handle(const std::string& line, const std::string& payload,
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "ERR no tensor";
     std::lock_guard<std::mutex> l(t->mu);
-    if (t->data.size() != total) return "ERR shape mismatch";
+    if (off == 0) ++t->open_writes;  // open first; abort/final closes
+    auto fail = [&](const char* e) {
+      if (t->open_writes > 0) --t->open_writes;
+      return std::string(e);
+    };
+    if (t->data.size() != total) return fail("ERR shape mismatch");
     int64_t step = t_in;
     if (off == 0 && step == 0) step = ++t->steps;
-    if (step <= 0) return "ERR bad step";
+    if (step <= 0) return fail("ERR bad step");
     float* w = t->data.data() + off;
     const float* g = grad.data();
     const size_t n = grad.size();
@@ -624,7 +690,7 @@ std::string handle(const std::string& line, const std::string& payload,
       const float m = static_cast<float>(p1);
       if (m != 0.f) {
         if (t->slot1.empty()) t->slot1.assign(total, 0.f);
-        if (t->slot1.size() != total) return "ERR slot mismatch";
+        if (t->slot1.size() != total) return fail("ERR slot mismatch");
         float* vel = t->slot1.data() + off;
         for (size_t i = 0; i < n; ++i) {
           vel[i] = m * vel[i] + g[i];
@@ -640,7 +706,7 @@ std::string handle(const std::string& line, const std::string& payload,
       if (t->slot1.empty()) t->slot1.assign(total, 0.f);
       if (t->slot2.empty()) t->slot2.assign(total, 0.f);
       if (t->slot1.size() != total || t->slot2.size() != total)
-        return "ERR slot mismatch";
+        return fail("ERR slot mismatch");
       float* m = t->slot1.data() + off;
       float* v = t->slot2.data() + off;
       const float c1 =
@@ -658,15 +724,18 @@ std::string handle(const std::string& line, const std::string& payload,
       const float eps = static_cast<float>(p1);
       const float init_acc = static_cast<float>(p2);
       if (t->slot2.empty()) t->slot2.assign(total, init_acc);
-      if (t->slot2.size() != total) return "ERR slot mismatch";
+      if (t->slot2.size() != total) return fail("ERR slot mismatch");
       float* acc = t->slot2.data() + off;
       for (size_t i = 0; i < n; ++i) {
         acc[i] += g[i] * g[i];
         w[i] -= lr * g[i] / (std::sqrt(acc[i]) + eps);
       }
     } else {
-      return "ERR unknown rule";
+      return fail("ERR unknown rule");
     }
+    if (off + grad.size() >= total && t->open_writes > 0)
+      --t->open_writes;
+    ++t->version;
     return "VAL " + std::to_string(step);
   }
   if (cmd == "SHUTDOWN") {
@@ -710,6 +779,13 @@ void serve_conn(int fd) {
   // answer the nonce challenge before its first real command
   {
     std::string nonce = g_token.empty() ? "" : make_nonce();
+    if (!g_token.empty() && nonce.empty()) {
+      // no entropy source: refuse rather than issue a replayable nonce
+      const char* err = "ERR no entropy for auth nonce\n";
+      send_all(fd, err, strlen(err));
+      close(fd);
+      return;
+    }
     std::string hello =
         "HELLO " + (g_token.empty() ? std::string("open") : nonce) + "\n";
     if (!send_all(fd, hello.data(), hello.size())) {

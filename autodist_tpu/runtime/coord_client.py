@@ -371,25 +371,70 @@ class CoordClient:
     def vget(self, key, shape=None, dtype=np.float32, wire=None):
         """Fetch a tensor as float32 host array, or None if absent.
         With a known ``shape``, oversized tensors are pulled as ranged
-        chunks."""
+        chunks.
+
+        Torn-read safe (ADVICE r4): every BGET opts into the server's
+        version field ("v" flag → ``version*2 + write_in_progress``).
+        An odd value means a chunked write is mid-flight; a value that
+        moves between this pull's chunks means a push landed between
+        them. Either way the whole pull retries. Old servers without
+        the field degrade to the previous (unchecked) behavior."""
         wire = _wire_dtype(wire)
         n_elems = int(np.prod(shape)) if shape is not None else None
         ranges = self._ranges(n_elems, wire) if n_elems else [(0, None)]
-        parts = []
-        for off, count in ranges:
-            suffix = '' if len(ranges) == 1 and off == 0 and \
-                (count is None or count == n_elems) else \
-                ' %d %d' % (off, count)
-            resp = self._rpc('BGET %s %s%s' % (key, wire, suffix))
-            if resp == 'NONE':
-                return None
-            if not resp.startswith('VAL'):
-                raise OSError('BGET %s failed: %s' % (key, resp))
-            parts.append(_decode(self._read_exact(int(resp[4:])), wire))
-        arr = parts[0] if len(parts) == 1 else np.concatenate(parts)
-        if shape is not None:
-            arr = arr.reshape(shape)
-        return arr.astype(dtype, copy=False)
+        # Retry policy: while the version ADVANCES between attempts the
+        # writer is alive and making progress (a multi-GB chunked push
+        # legitimately holds the flag for seconds) — keep waiting, up
+        # to a generous cap.  A version that stays odd AND unchanged
+        # across several backoffs is the dead-mid-push signature.
+        last_ver = None
+        stalled = 0
+        for attempt in range(100):
+            parts = []
+            first_ver = None
+            torn = False
+            for off, count in ranges:
+                suffix = '' if len(ranges) == 1 and off == 0 and \
+                    (count is None or count == n_elems) else \
+                    ' %d %d' % (off, count)
+                resp = self._rpc('BGET %s %s%s v' % (key, wire, suffix))
+                if resp == 'NONE':
+                    return None
+                if not resp.startswith('VAL'):
+                    raise OSError('BGET %s failed: %s' % (key, resp))
+                fields = resp.split()
+                parts.append(
+                    _decode(self._read_exact(int(fields[1])), wire))
+                ver = int(fields[2]) if len(fields) > 2 else None
+                if ver is not None and ver & 1:  # write in progress
+                    torn = True
+                elif first_ver is None:
+                    first_ver = ver
+                elif ver != first_ver:
+                    torn = True
+                if torn:
+                    if ver == last_ver:
+                        stalled += 1
+                    else:
+                        stalled = 0
+                        last_ver = ver
+                    break
+            if not torn:
+                arr = parts[0] if len(parts) == 1 else \
+                    np.concatenate(parts)
+                if shape is not None:
+                    arr = arr.reshape(shape)
+                return arr.astype(dtype, copy=False)
+            if stalled >= 5:
+                raise OSError(
+                    'BGET %s: a chunked write is stuck mid-flight '
+                    '(version parity odd and not advancing) — a peer '
+                    'likely died mid-push' % key)
+            time.sleep(min(0.2, 0.002 * (attempt + 1)))
+        raise OSError(
+            'BGET %s: tensor kept changing under the pull (100 '
+            'attempts) — a writer is pushing continuously without the '
+            'staleness gate' % key)
 
     def vadd(self, key, delta, wire=None):
         """Atomically add a delta elementwise (apply-per-push, the

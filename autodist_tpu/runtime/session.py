@@ -243,8 +243,9 @@ class Session:
                         except OSError:
                             pass
 
-            threading.Thread(target=beat_loop, daemon=True,
-                             name='autodist-heartbeat').start()
+            self._hb_thread = threading.Thread(
+                target=beat_loop, daemon=True, name='autodist-heartbeat')
+            self._hb_thread.start()
 
     def _user_node_count(self):
         return sum(1 for n in self._graph_item.graph.nodes
@@ -957,6 +958,16 @@ class Session:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):
+        # stop heartbeats FIRST (ADVICE r4): a beat written after the
+        # run-end purge would leak a stale hb/<ns>/ key on long-lived
+        # endpoints.  Workers therefore go silent before incrementing
+        # 'closed', and the purger's own thread is joined before it
+        # deletes the hb namespace.
+        if getattr(self, '_hb_stop', None) is not None:
+            self._hb_stop.set()
+            thread = getattr(self, '_hb_thread', None)
+            if thread is not None and thread.is_alive():
+                thread.join(timeout=15.0)
         if not self._closed and self._loose and self._coord is not None:
             # clean shutdown is not a crash: publish a done marker so
             # peers exclude us from dead-worker checks, and advance our
@@ -989,8 +1000,6 @@ class Session:
             except Exception:  # noqa: BLE001 - service may be gone
                 pass
         self._closed = True
-        if getattr(self, '_hb_stop', None) is not None:
-            self._hb_stop.set()
         for client in getattr(self, '_ps_clients', []):
             if client is not self._coord:
                 try:

@@ -349,8 +349,9 @@ def bench_scaling(steps=5):
         comm[dp] = stats.get('collective_bytes', {})
     # a dp=1 program must compile with ZERO collectives — fail fast,
     # before the (expensive) realistic-shape accounting below
-    assert not comm.get(1), 'dp=1 program emitted collectives: %r' % (
-        comm.get(1),)
+    if comm.get(1):  # lowering invariant; assert would vanish under -O
+        raise RuntimeError(
+            'dp=1 program emitted collectives: %r' % (comm.get(1),))
     t1, tps1 = times[1]
     tn, tpsn = times[n]
     # realistic-shape wire accounting (compile-only — the CPU mesh

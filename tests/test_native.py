@@ -226,9 +226,42 @@ def test_tensor_data_plane_ranged_get(coord):
     c.vset('ranged', t)
     resp = c._rpc('BGET ranged f32 10 5')
     assert resp.startswith('VAL')
-    got = np.frombuffer(c._read_exact(int(resp[4:])), np.float32)
+    got = np.frombuffer(c._read_exact(int(resp.split()[1])), np.float32)
     np.testing.assert_array_equal(got, t[10:15])
     assert c._rpc('BGET ranged f32 96 10').startswith('ERR bad range')
+
+
+def test_torn_read_detection(coord):
+    """A chunked write in flight is visible to readers (ADVICE r4):
+    BGET's opt-in version field is odd while any chunked BSET/BADD
+    sequence is between its first and final chunk, and vget refuses to
+    return the half-written tensor."""
+    c = coord()
+    w = coord()
+    t = np.arange(10, dtype=np.float32)
+    c.vset('seq', t)
+    resp = c._rpc('BGET seq f32 v')
+    fields = resp.split()
+    c._read_exact(int(fields[1]))
+    assert len(fields) == 3 and int(fields[2]) % 2 == 0
+    # writer sends only the FIRST chunk of a 2-chunk reset
+    half = t[:5].tobytes()
+    assert w._rpc('BSET seq %d f32 0 10' % len(half), half) == 'OK'
+    resp = c._rpc('BGET seq f32 v')
+    fields = resp.split()
+    c._read_exact(int(fields[1]))
+    assert int(fields[2]) % 2 == 1  # write in flight
+    with pytest.raises(OSError, match='stuck mid-flight'):
+        c.vget('seq', shape=(10,))
+    # final chunk lands -> even version, reads succeed again
+    assert w._rpc('BSET seq %d f32 5 10' % len(half),
+                  t[5:].tobytes()) == 'OK'
+    np.testing.assert_array_equal(c.vget('seq', shape=(10,)), t)
+    # ranged reads carry the version too (chunk-mismatch detection)
+    resp = c._rpc('BGET seq f32 0 5 v')
+    fields = resp.split()
+    c._read_exact(int(fields[1]))
+    assert len(fields) == 3 and int(fields[2]) % 2 == 0
 
 
 def test_oversized_payload_declaration_refused(coord):
