@@ -154,6 +154,28 @@ struct Store {
 Store g_store;
 std::string g_token;  // empty = open service (loopback-only deployments)
 
+// Bookkeeping for one mutating frame of a (possibly chunked) write
+// sequence — the single place the open_writes invariant lives for
+// BSET/BADD/BSTEP.  Construct AFTER locking the tensor: the offset-0
+// frame opens the sequence.  Call fail(e) on any rejection (aborts the
+// sequence, so a malformed or mismatched frame cannot wedge readers on
+// a permanently-odd version), finish() after a successful mutation
+// (closes the sequence on its final chunk and bumps the version).
+struct SeqFrame {
+  Tensor* t;
+  explicit SeqFrame(Tensor* t, size_t off) : t(t) {
+    if (off == 0) ++t->open_writes;
+  }
+  std::string fail(const char* e) {
+    if (t->open_writes > 0) --t->open_writes;
+    return e;
+  }
+  void finish(bool final_chunk) {
+    if (final_chunk && t->open_writes > 0) --t->open_writes;
+    ++t->version;
+  }
+};
+
 std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
   std::lock_guard<std::mutex> l(g_store.mu);
   auto it = g_store.tensors.find(key);
@@ -162,6 +184,19 @@ std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
   auto t = std::make_shared<Tensor>();
   g_store.tensors[key] = t;
   return t;
+}
+
+// A frame rejected before its tensor lock (bad payload / bad range)
+// still aborts the sequence its writer opened at offset 0 — otherwise
+// one malformed chunk would wedge the key's readers on a permanently-
+// odd version until DELNS removes the tensor.
+std::string abort_open_seq(const std::string& key, const char* e) {
+  std::shared_ptr<Tensor> t = find_tensor(key, /*create=*/false);
+  if (t) {
+    std::lock_guard<std::mutex> l(t->mu);
+    if (t->open_writes > 0) --t->open_writes;
+  }
+  return e;
 }
 
 // -- sha256 / hmac (handshake) -----------------------------------------------
@@ -555,22 +590,14 @@ std::string handle(const std::string& line, const std::string& payload,
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
     std::vector<float> vals;
-    if (!decode_wire(payload, wire, &vals)) return "ERR bad payload";
+    if (!decode_wire(payload, wire, &vals))
+      return abort_open_seq(k, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, vals.size(), &off, &total))
-      return "ERR bad range";
+      return abort_open_seq(k, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    // open the write sequence FIRST; every later return (error =
-    // abort, final chunk = complete) closes it, so the counter can't
-    // wedge and concurrent writers' counts are never clobbered (++,
-    // not =1: another sequence's final-chunk decrement must not zero
-    // the flag while this reset is still mid-flight).
-    if (off == 0) ++t->open_writes;
-    auto fail = [&](const char* e) {
-      if (t->open_writes > 0) --t->open_writes;
-      return std::string(e);
-    };
+    SeqFrame seq(t.get(), off);
     if (off == 0) {  // a (re)set starts at its first chunk
       t->data.assign(total, 0.f);
       t->slot1.clear();
@@ -578,11 +605,9 @@ std::string handle(const std::string& line, const std::string& payload,
       t->pushes = 0;
       t->steps = 0;
     }
-    if (t->data.size() != total) return fail("ERR shape mismatch");
+    if (t->data.size() != total) return seq.fail("ERR shape mismatch");
     std::copy(vals.begin(), vals.end(), t->data.begin() + off);
-    if (off + vals.size() >= total && t->open_writes > 0)
-      --t->open_writes;
-    ++t->version;
+    seq.finish(off + vals.size() >= total);
     return "OK";
   }
   if (cmd == "BSTAT") {
@@ -638,25 +663,20 @@ std::string handle(const std::string& line, const std::string& payload,
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
     std::vector<float> delta;
-    if (!decode_wire(payload, wire, &delta)) return "ERR bad payload";
+    if (!decode_wire(payload, wire, &delta))
+      return abort_open_seq(k, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, delta.size(), &off, &total))
-      return "ERR bad range";
+      return abort_open_seq(k, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    if (off == 0) ++t->open_writes;  // open first; abort/final closes
-    auto fail = [&](const char* e) {
-      if (t->open_writes > 0) --t->open_writes;
-      return std::string(e);
-    };
+    SeqFrame seq(t.get(), off);
     if (t->data.empty()) t->data.assign(total, 0.f);
-    if (t->data.size() != total) return fail("ERR shape mismatch");
+    if (t->data.size() != total) return seq.fail("ERR shape mismatch");
     if (off == 0) ++t->pushes;  // one logical push counts once
     for (size_t i = 0; i < delta.size(); ++i)
       t->data[off + i] += delta[i];
-    if (off + delta.size() >= total && t->open_writes > 0)
-      --t->open_writes;
-    ++t->version;
+    seq.finish(off + delta.size() >= total);
     return "VAL " + std::to_string(t->pushes);
   }
   if (cmd == "BSTEP") {
@@ -666,22 +686,19 @@ std::string handle(const std::string& line, const std::string& payload,
     double p0 = 0, p1 = 0, p2 = 0, p3 = 0;
     in >> k >> nbytes >> wire >> rule >> t_in >> p0 >> p1 >> p2 >> p3;
     std::vector<float> grad;
-    if (!decode_wire(payload, wire, &grad)) return "ERR bad payload";
+    if (!decode_wire(payload, wire, &grad))
+      return abort_open_seq(k, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, grad.size(), &off, &total))
-      return "ERR bad range";
+      return abort_open_seq(k, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "ERR no tensor";
     std::lock_guard<std::mutex> l(t->mu);
-    if (off == 0) ++t->open_writes;  // open first; abort/final closes
-    auto fail = [&](const char* e) {
-      if (t->open_writes > 0) --t->open_writes;
-      return std::string(e);
-    };
-    if (t->data.size() != total) return fail("ERR shape mismatch");
+    SeqFrame seq(t.get(), off);
+    if (t->data.size() != total) return seq.fail("ERR shape mismatch");
     int64_t step = t_in;
     if (off == 0 && step == 0) step = ++t->steps;
-    if (step <= 0) return fail("ERR bad step");
+    if (step <= 0) return seq.fail("ERR bad step");
     float* w = t->data.data() + off;
     const float* g = grad.data();
     const size_t n = grad.size();
@@ -690,7 +707,7 @@ std::string handle(const std::string& line, const std::string& payload,
       const float m = static_cast<float>(p1);
       if (m != 0.f) {
         if (t->slot1.empty()) t->slot1.assign(total, 0.f);
-        if (t->slot1.size() != total) return fail("ERR slot mismatch");
+        if (t->slot1.size() != total) return seq.fail("ERR slot mismatch");
         float* vel = t->slot1.data() + off;
         for (size_t i = 0; i < n; ++i) {
           vel[i] = m * vel[i] + g[i];
@@ -706,7 +723,7 @@ std::string handle(const std::string& line, const std::string& payload,
       if (t->slot1.empty()) t->slot1.assign(total, 0.f);
       if (t->slot2.empty()) t->slot2.assign(total, 0.f);
       if (t->slot1.size() != total || t->slot2.size() != total)
-        return fail("ERR slot mismatch");
+        return seq.fail("ERR slot mismatch");
       float* m = t->slot1.data() + off;
       float* v = t->slot2.data() + off;
       const float c1 =
@@ -724,14 +741,14 @@ std::string handle(const std::string& line, const std::string& payload,
       const float eps = static_cast<float>(p1);
       const float init_acc = static_cast<float>(p2);
       if (t->slot2.empty()) t->slot2.assign(total, init_acc);
-      if (t->slot2.size() != total) return fail("ERR slot mismatch");
+      if (t->slot2.size() != total) return seq.fail("ERR slot mismatch");
       float* acc = t->slot2.data() + off;
       for (size_t i = 0; i < n; ++i) {
         acc[i] += g[i] * g[i];
         w[i] -= lr * g[i] / (std::sqrt(acc[i]) + eps);
       }
     } else {
-      return fail("ERR unknown rule");
+      return seq.fail("ERR unknown rule");
     }
     if (off + grad.size() >= total && t->open_writes > 0)
       --t->open_writes;

@@ -172,6 +172,12 @@ def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
 class CoordClient:
     """Blocking line-protocol client."""
 
+    # How long a torn pull waits for an in-flight chunked write whose
+    # version has stopped advancing before declaring the writer dead.
+    # Must cover one full chunk frame's encode+wire time (the version
+    # only moves per landed frame); tests shrink it.
+    STALL_TIMEOUT_S = 10.0
+
     def __init__(self, address=None, timeout=None, op_timeout=None):
         if address is None:
             raw = ENV.AUTODIST_COORD_SERVICE_ADDR.val
@@ -385,10 +391,13 @@ class CoordClient:
         # Retry policy: while the version ADVANCES between attempts the
         # writer is alive and making progress (a multi-GB chunked push
         # legitimately holds the flag for seconds) — keep waiting, up
-        # to a generous cap.  A version that stays odd AND unchanged
-        # across several backoffs is the dead-mid-push signature.
+        # to a generous cap.  The version only moves when a whole chunk
+        # frame lands, and one frame can take AUTODIST_PS_CHUNK_BYTES
+        # of wire time, so "stalled" is judged on a wall-clock window
+        # (STALL_TIMEOUT_S), not an attempt count: a version that stays
+        # odd AND unchanged that long is the dead-mid-push signature.
         last_ver = None
-        stalled = 0
+        last_progress = time.monotonic()
         for attempt in range(100):
             parts = []
             first_ver = None
@@ -413,11 +422,9 @@ class CoordClient:
                 elif ver != first_ver:
                     torn = True
                 if torn:
-                    if ver == last_ver:
-                        stalled += 1
-                    else:
-                        stalled = 0
+                    if ver != last_ver:
                         last_ver = ver
+                        last_progress = time.monotonic()
                     break
             if not torn:
                 arr = parts[0] if len(parts) == 1 else \
@@ -425,12 +432,13 @@ class CoordClient:
                 if shape is not None:
                     arr = arr.reshape(shape)
                 return arr.astype(dtype, copy=False)
-            if stalled >= 5:
+            if time.monotonic() - last_progress > self.STALL_TIMEOUT_S:
                 raise OSError(
                     'BGET %s: a chunked write is stuck mid-flight '
-                    '(version parity odd and not advancing) — a peer '
-                    'likely died mid-push' % key)
-            time.sleep(min(0.2, 0.002 * (attempt + 1)))
+                    '(version parity odd and not advancing for %.0fs) '
+                    '— a peer likely died mid-push'
+                    % (key, self.STALL_TIMEOUT_S))
+            time.sleep(min(0.2, 0.01 * (attempt + 1)))
         raise OSError(
             'BGET %s: tensor kept changing under the pull (100 '
             'attempts) — a writer is pushing continuously without the '

@@ -231,11 +231,13 @@ def test_tensor_data_plane_ranged_get(coord):
     assert c._rpc('BGET ranged f32 96 10').startswith('ERR bad range')
 
 
-def test_torn_read_detection(coord):
+def test_torn_read_detection(coord, monkeypatch):
     """A chunked write in flight is visible to readers (ADVICE r4):
     BGET's opt-in version field is odd while any chunked BSET/BADD
     sequence is between its first and final chunk, and vget refuses to
     return the half-written tensor."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setattr(CoordClient, 'STALL_TIMEOUT_S', 0.3)
     c = coord()
     w = coord()
     t = np.arange(10, dtype=np.float32)
@@ -262,6 +264,16 @@ def test_torn_read_detection(coord):
     fields = resp.split()
     c._read_exact(int(fields[1]))
     assert len(fields) == 3 and int(fields[2]) % 2 == 0
+    # a REJECTED frame aborts the sequence it opened instead of wedging
+    # readers on a permanently-odd version: open a sequence, then send
+    # a chunk with a bad range
+    assert w._rpc('BSET seq %d f32 0 10' % len(half), half) == 'OK'
+    assert w._rpc('BSET seq %d f32 9 10' % len(half),
+                  half).startswith('ERR bad range')
+    resp = c._rpc('BGET seq f32 v')
+    fields = resp.split()
+    c._read_exact(int(fields[1]))
+    assert int(fields[2]) % 2 == 0  # sequence aborted, reads flow
 
 
 def test_oversized_payload_declaration_refused(coord):
