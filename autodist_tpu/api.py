@@ -274,6 +274,8 @@ class Trainer:
         options = {'microbatches': self.spec.microbatches,
                    'pp_schedule': getattr(self.spec, 'pp_schedule',
                                           'gpipe'),
+                   'pp_variant': getattr(self.spec, 'pp_variant',
+                                         'auto'),
                    'sp_mode': getattr(self.spec, 'sp_mode', 'ring')}
 
         def per_token(params, batch):

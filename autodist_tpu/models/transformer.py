@@ -246,7 +246,8 @@ class TransformerLM(Module):
         targets = batch['targets']
         pipe_axis = manual_axis(AXIS_PIPELINE)
         if pipe_axis is not None and \
-                ctx_option('pp_schedule', 'gpipe') == '1f1b':
+                ctx_option('pp_schedule', 'gpipe') == '1f1b' and \
+                ctx_option('pp_variant', 'auto') != 'legacy':
             return self._loss_1f1b(params, batch, pipe_axis)
         x, aux = self.hidden_with_aux(params, batch['tokens'])
         b, s = targets.shape
@@ -306,7 +307,8 @@ class TransformerLM(Module):
                            ctx_option('microbatches', 1),
                            tail_fn=tail, extra=batch['targets'],
                            tail_params=tail_params,
-                           head_fn=head, head_params=head_params)
+                           head_fn=head, head_params=head_params,
+                           variant=ctx_option('pp_variant', 'auto'))
 
     def _chunk_nll(self, params, x, targets):
         logits = constrain(self._head_logits(params, x).astype(jnp.float32),

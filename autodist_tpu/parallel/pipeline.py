@@ -14,18 +14,27 @@ Two schedules:
   starts only after every microbatch's forward: all ``M`` microbatches'
   residuals are live at the fwd/bwd boundary (the GPipe memory profile).
 - :func:`one_f_one_b` with ``tail_params`` — a REAL 1F1B: a
-  ``jax.custom_vjp`` whose hand-written backward interleaves one
-  recompute-forward and one backward per schedule step. A rank's live
-  working set is a circular stash of at most ``2(pp-1)+1`` microbatch
-  activations — bounded by the pipe depth, independent of ``M``. The
+  ``jax.custom_vjp`` with a hand-written interleaved backward. The
   head/loss folds into the last stage (``tail_fn``) and the embedding
-  into the first (``head_fn``), so no full-batch ``[B, s, d]``
-  activation, logits slab, or input cotangent ever materializes: the
-  region's big tensors are all O(pp x microbatch). Cost: the backward
-  phase re-runs the forward chain to feed the stash (activations are
-  never saved across the fwd/bwd boundary), so a training step is
-  ~3 forward + 1 backward block passes — the standard 1F1B-with-full-
-  remat trade (memory bounded in pp buys arbitrarily many microbatches).
+  into the first (``head_fn``). Two variants of the backward
+  (``variant=``, default ``'auto'``):
+
+  * ``'remat'`` — the forward saves NO activations; the backward
+    re-runs the forward chain and interleaves one recompute-vjp per
+    step. A rank's live working set is a circular stash of at most
+    ``2(pp-1)+1`` microbatch activations — bounded by the pipe depth,
+    independent of ``M``; no full-batch ``[B, s, d]`` activation,
+    logits slab, or input cotangent ever materializes. Cost: a step is
+    ~3 forward + 1 backward block passes.
+  * ``'stash'`` — the forward stashes each microbatch's stack INPUT
+    (one boundary activation per microbatch: a single ``[B, ...]``
+    hidden slab per rank, still far below GPipe's per-layer
+    residuals), and the backward skips the chain re-forward — one
+    vjp-internal recompute only, ~2 forward + 1 backward passes.
+  * ``'auto'`` — ``'stash'`` while the stash fits
+    ``AUTODIST_PP_STASH_LIMIT_MB`` (default 2048) per rank, else
+    ``'remat'``: trade the memory bound for the faster step whenever
+    memory allows.
 
 Delivery is collective-clean: microbatch inputs ride a backward-rotating
 ppermute relay register (owner ``j % pp`` sits that many backward hops
@@ -194,7 +203,7 @@ def gpipe(block_fn, stacked_params, x, axis_name, microbatches):
 
 def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
                 tail_fn=None, extra=None, tail_params=None,
-                head_fn=None, head_params=None):
+                head_fn=None, head_params=None, variant='auto'):
     """1F1B schedule with per-rank microbatch residency.
 
     Same fill/steady/drain forward timing as :func:`gpipe` (the forward
@@ -202,9 +211,12 @@ def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
     activations never live across the schedule. Two modes:
 
     - **fused (pass ``tail_params``)** — the real 1F1B: a custom-vjp
-      whose hand-written backward interleaves recompute-forwards and
-      backwards, bounding each rank's live activations at a
-      ``2(pp-1)+1``-slot circular stash (independent of ``M``). Fold
+      with a hand-written interleaved backward (see the module
+      docstring for the ``variant`` trade: ``'remat'`` bounds each
+      rank's live activations at a ``2(pp-1)+1``-slot circular stash,
+      ``'stash'`` saves one boundary activation per microbatch and
+      skips the chain re-forward, ``'auto'`` picks ``'stash'`` while
+      it fits ``AUTODIST_PP_STASH_LIMIT_MB``). Fold
       the head + loss into ``tail_fn(tail_params, h, extra_mb)`` (runs
       on the last stage per microbatch) and the embedding into
       ``head_fn(head_params, x_mb)`` (first stage) so the region's
@@ -245,7 +257,7 @@ def one_f_one_b(block_fn, stacked_params, x, axis_name, microbatches,
                 'gradients')
         return _fused_1f1b(block_fn, stacked_params, x, axis_name, M,
                            tail_fn, extra, tail_params, head_fn,
-                           head_params)
+                           head_params, variant)
     if head_fn is not None:
         # the legacy schedule has no head slot; silently skipping it
         # would diverge from the pp==1 branch above
@@ -340,11 +352,19 @@ def _legacy_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
 
 
 def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
-                extra, tail_params, head_fn, head_params):
-    """Custom-vjp 1F1B (see :func:`one_f_one_b`): forward saves NO
-    activations; the backward phase re-runs the forward chain and
-    interleaves one recompute-vjp per step, stash bounded at
-    ``2(pp-1)+1`` microbatches per rank."""
+                extra, tail_params, head_fn, head_params,
+                variant='auto'):
+    """Custom-vjp 1F1B (see :func:`one_f_one_b`).
+
+    ``variant='remat'``: forward saves NO activations; the backward
+    re-runs the forward chain and interleaves one recompute-vjp per
+    step, stash bounded at ``2(pp-1)+1`` microbatches per rank.
+    ``variant='stash'``: forward saves each microbatch's stack-input
+    boundary activation ([M, mb, ...] per rank — one full-batch hidden
+    slab); the backward indexes the stash directly (no chain
+    re-forward, no relay), paying only the vjp-internal recompute.
+    ``'auto'`` resolves to 'stash' while the stash fits
+    ``AUTODIST_PP_STASH_LIMIT_MB`` per rank."""
     pp = lax.axis_size(axis_name)
     B = x.shape[0]
     assert B % M == 0, 'batch %d not divisible by microbatches %d' % (B, M)
@@ -376,6 +396,19 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             'schedule (no tail_params)')
     x_differentiable = jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
 
+    if variant not in ('auto', 'remat', 'stash'):
+        raise ValueError('unknown 1F1B variant %r' % (variant,))
+    if variant == 'auto':
+        import os
+        probe = jax.eval_shape(
+            lambda v: head_fn(head_params, v),
+            jax.ShapeDtypeStruct((mb,) + x.shape[1:],
+                                 jnp.asarray(x).dtype))
+        stash_bytes = M * int(np.prod(probe.shape)) * probe.dtype.itemsize
+        limit = float(os.environ.get('AUTODIST_PP_STASH_LIMIT_MB',
+                                     '2048')) * (1 << 20)
+        variant = 'stash' if stash_bytes <= limit else 'remat'
+
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
     rev_perm = [(i, i - 1) for i in range(1, pp)]
     back_rot = _back_rotation(pp)
@@ -387,7 +420,7 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             return jnp.zeros_like(v)
         return np.zeros(v.shape, jax.dtypes.float0)
 
-    def run_forward(sp, tp, hp, x_, e_):
+    def run_forward(sp, tp, hp, x_, e_, with_stash=False):
         rank = lax.axis_index(axis_name)
         xs = x_.reshape(M, mb, *x_.shape[1:])
         es = e_.reshape(M, mb, *e_.shape[1:])
@@ -402,7 +435,8 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
         zero_out = jnp.zeros(out_shape.shape, out_shape.dtype)
 
         def step(carry, t):
-            reg_x, reg_e, state_h, state_e, own_out, aux_acc = carry
+            reg_x, reg_e, state_h, state_e, own_out, aux_acc, stash = \
+                carry
             reg_x = _inject(own_x, reg_x, t, share, pp)
             reg_e = _inject(own_e, reg_e, t, share, pp)
             # first stage embeds its incoming microbatch (head folded
@@ -415,6 +449,15 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             inp_h = jnp.where(rank == 0, head_fn(hp, reg_x), state_h)
             inp_e = jnp.where(rank == 0, reg_e, state_e)
             valid = jnp.logical_and(t >= rank, t - rank < M)
+            if with_stash:
+                # stash-variant: keep this microbatch's stack INPUT for
+                # the backward (j = t - rank is the microbatch this
+                # rank processes at step t)
+                j_w = jnp.clip(t - rank, 0, M - 1)
+                prev_s = lax.dynamic_index_in_dim(stash, j_w, 0,
+                                                  keepdims=False)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(valid, inp_h, prev_s), j_w, 0)
             h, aux = lax.cond(
                 valid, lambda v: stack(sp, v),
                 lambda v: (v, jnp.zeros((), jnp.float32)), inp_h)
@@ -436,16 +479,21 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             nxt_e = lax.ppermute(inp_e, axis_name, fwd_perm)
             reg_x = lax.ppermute(reg_x, axis_name, back_rot)
             reg_e = lax.ppermute(reg_e, axis_name, back_rot)
-            return (reg_x, reg_e, nxt_h, nxt_e, own_out, aux_acc), None
+            return (reg_x, reg_e, nxt_h, nxt_e, own_out, aux_acc,
+                    stash), None
 
         own_out = jnp.zeros((share,) + zero_out.shape, zero_out.dtype)
+        stash0 = jnp.zeros((M,) + zero_h.shape, zero_h.dtype) \
+            if with_stash else jnp.zeros((1, 1))
         carry0 = (zero_x, zero_e, zero_h, zero_e, own_out,
-                  jnp.zeros((), jnp.float32))
-        (_, _, _, _, own_out, aux_acc), _ = lax.scan(
+                  jnp.zeros((), jnp.float32), stash0)
+        (_, _, _, _, own_out, aux_acc, stash), _ = lax.scan(
             step, carry0, jnp.arange(M + pp - 1))
         # PER-RANK partials: the cross-rank psum happens OUTSIDE the
         # custom_vjp (see _scatter_own)
         out_part = _scatter_own(own_out, rank, pp, share, mb, B)
+        if with_stash:
+            return out_part, aux_acc, stash
         return out_part, aux_acc
 
     def run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux):
@@ -604,18 +652,117 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
             dx = zero_ct(x_)
         return g_sp, g_tp, g_hp, dx, zero_ct(e_)
 
-    @jax.custom_vjp
-    def fused(sp, tp, hp, x_, e_):
-        return run_forward(sp, tp, hp, x_, e_)
+    def run_backward_stash(sp, tp, hp, x_, e_, stash, ct_out, ct_aux):
+        """Stash-variant backward: no chain re-forward, no relay of
+        inputs — every rank indexes its saved stack-input stash and the
+        primal streams directly.  Rank r runs microbatch j's stack-vjp
+        at step ``u = j + (pp-1-r)``; the input cotangent it produces
+        is exactly what rank r-1 needs one step later (one rev-ppermute
+        hop per step).  Tail/head/stack vjps run UNCONDITIONALLY with
+        masked cotangents (J^T·0 = 0): rank-divergent conds around
+        sharding-constrained code deadlock (see run_forward note), so
+        the (pp-1)/(M+pp-1) bubble burns compute on zeros instead."""
+        rank = lax.axis_index(axis_name)
+        xs = x_.reshape(M, mb, *x_.shape[1:])
+        es = e_.reshape(M, mb, *e_.shape[1:])
+        cts = ct_out.reshape(M, mb, *ct_out.shape[1:])
+        ct_aux_mb = ct_aux.astype(jnp.float32)
 
-    def fused_fwd(sp, tp, hp, x_, e_):
-        out = run_forward(sp, tp, hp, x_, e_)
-        return out, (sp, tp, hp, x_, e_)
+        g_sp0 = jax.tree.map(jnp.zeros_like, sp)
+        g_tp0 = jax.tree.map(jnp.zeros_like, tp)
+        g_hp0 = jax.tree.map(jnp.zeros_like, hp)
+        zero_x = jnp.zeros((mb,) + x_.shape[1:], x_.dtype)
+        dx0 = jnp.zeros((M,) + zero_x.shape, zero_x.dtype) \
+            if x_differentiable else None
 
-    def fused_bwd(res, cts):
-        sp, tp, hp, x_, e_ = res
-        ct_out, ct_aux = cts
-        return run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux)
+        def step(carry, u):
+            ct_reg, g_sp, g_tp, g_hp, dx_buf = carry
+            j = u - (pp - 1 - rank)
+            valid = jnp.logical_and(j >= 0, j < M)
+            jc = jnp.clip(j, 0, M - 1)
+            h_in = lax.dynamic_index_in_dim(stash, jc, 0,
+                                            keepdims=False)
+            inp_e = lax.dynamic_index_in_dim(es, jc, 0, keepdims=False)
+            # ONE stack recompute, inside the vjp (the stash variant's
+            # whole point: no second, chain-level recompute)
+            (h_out, _), stack_vjp_fn = jax.vjp(
+                lambda sp_, h_: stack(sp_, h_), sp, h_in)
+            # tail vjp at the last rank, cotangent masked elsewhere
+            ct_mb = lax.dynamic_index_in_dim(cts, jc, 0, keepdims=False)
+            ct_mb = jnp.where(
+                jnp.logical_and(valid, rank == pp - 1), ct_mb,
+                jnp.zeros_like(ct_mb))
+            _, tail_vjp_fn = jax.vjp(
+                lambda tp_, h_, e_in: tail_fn(tp_, h_, e_in),
+                tp, h_out, inp_e)
+            d_tp, ct_h_tail = tail_vjp_fn(ct_mb)[:2]
+            g_tp = jax.tree.map(jnp.add, g_tp, d_tp)
+            ct_h = jnp.where(rank == pp - 1, ct_h_tail, ct_reg)
+            ct_h = jnp.where(valid, ct_h, jnp.zeros_like(ct_h))
+            d_sp, d_h_in = stack_vjp_fn(
+                (ct_h, jnp.where(valid, ct_aux_mb, 0.0)))
+            g_sp = jax.tree.map(jnp.add, g_sp, d_sp)
+            # head vjp at rank 0 (embed recompute from the token primal)
+            x_in = lax.dynamic_index_in_dim(xs, jc, 0, keepdims=False)
+            _, head_vjp_fn = jax.vjp(
+                lambda hp_, xv: head_fn(hp_, xv), hp, x_in)
+            ct_head = jnp.where(
+                jnp.logical_and(valid, rank == 0), d_h_in,
+                jnp.zeros_like(d_h_in))
+            d_hp, d_x = head_vjp_fn(ct_head)
+            g_hp = jax.tree.map(jnp.add, g_hp, d_hp)
+            if x_differentiable:
+                take_dx = jnp.logical_and(valid, rank == 0)
+                prev_dx = lax.dynamic_index_in_dim(dx_buf, jc, 0,
+                                                   keepdims=False)
+                dx_buf = lax.dynamic_update_index_in_dim(
+                    dx_buf, jnp.where(take_dx, d_x, prev_dx), jc, 0)
+            ct_reg = lax.ppermute(d_h_in, axis_name, rev_perm)
+            return (ct_reg, g_sp, g_tp, g_hp, dx_buf), None
+
+        h_probe = stash[0]
+        carry0 = (jnp.zeros_like(h_probe), g_sp0, g_tp0, g_hp0, dx0)
+        carry, _ = lax.scan(step, carry0, jnp.arange(M + pp - 1))
+        _, g_sp, g_tp, g_hp, dx_buf = carry
+        # PER-RANK PARTIALS, same convention as the remat backward: the
+        # shard_map boundary psums replicated primals' cotangents
+        if x_differentiable:
+            dx = jnp.where(rank == 0, dx_buf, jnp.zeros_like(dx_buf))
+            dx = dx.reshape(x_.shape).astype(x_.dtype)
+        else:
+            dx = zero_ct(x_)
+        return g_sp, g_tp, g_hp, dx, zero_ct(e_)
+
+    if variant == 'stash':
+        @jax.custom_vjp
+        def fused(sp, tp, hp, x_, e_):
+            # primal (non-differentiated) path: no stash — eval steps
+            # must not pay the [M, mb, ...] hidden slab
+            return run_forward(sp, tp, hp, x_, e_)
+
+        def fused_fwd(sp, tp, hp, x_, e_):
+            out, aux, stash = run_forward(sp, tp, hp, x_, e_,
+                                          with_stash=True)
+            return (out, aux), (sp, tp, hp, x_, e_, stash)
+
+        def fused_bwd(res, cts):
+            sp, tp, hp, x_, e_, stash = res
+            ct_out, ct_aux = cts
+            return run_backward_stash(sp, tp, hp, x_, e_, stash,
+                                      ct_out, ct_aux)
+    else:
+        @jax.custom_vjp
+        def fused(sp, tp, hp, x_, e_):
+            return run_forward(sp, tp, hp, x_, e_)
+
+        def fused_fwd(sp, tp, hp, x_, e_):
+            out = run_forward(sp, tp, hp, x_, e_)
+            return out, (sp, tp, hp, x_, e_)
+
+        def fused_bwd(res, cts):
+            sp, tp, hp, x_, e_ = res
+            ct_out, ct_aux = cts
+            return run_backward(sp, tp, hp, x_, e_, ct_out, ct_aux)
 
     fused.defvjp(fused_fwd, fused_bwd)
     out_part, aux_part = fused(stacked_params, tail_params, head_params,

@@ -36,6 +36,11 @@ def main():
                    help="'1f1b': custom-vjp interleaved schedule — live "
                         'activations bounded by the pipe depth '
                         '(embed/head folded into the first/last stages)')
+    p.add_argument('--pp-variant', default='auto',
+                   choices=['auto', 'remat', 'stash', 'legacy'],
+                   help='1f1b backward: remat (pp-bounded memory, ~3 '
+                        'fwd passes) | stash (per-microbatch boundary '
+                        'stash, ~2 fwd) | auto (stash while it fits)')
     p.add_argument('--grad-accum', type=int, default=1)
     p.add_argument('--fp32', action='store_true')
     args = p.parse_args()
@@ -60,6 +65,7 @@ def main():
                         sp_mode=args.sp_mode, zero=args.zero,
                         microbatches=args.microbatches,
                         pp_schedule=args.pp_schedule,
+                        pp_variant=args.pp_variant,
                         grad_accum=args.grad_accum)
     trainer = Trainer(model, opt, spec=spec)
     state = trainer.init(jax.random.PRNGKey(0))

@@ -76,35 +76,43 @@ def test_pipeline_parallel_matches_dp(batch):
     assert np.allclose(pp, base, atol=2e-4), (pp, base)
 
 
-def test_pipeline_1f1b_matches_dp(batch):
+@pytest.mark.parametrize('variant', ['remat', 'stash'])
+def test_pipeline_1f1b_matches_dp(batch, variant):
     """The 1F1B schedule (per-rank microbatch residency) is numerically
-    identical to DP, like GPipe."""
+    identical to DP, like GPipe — in both backward variants (remat:
+    chain re-forward, pp-bounded stash; stash: saved boundary
+    activations, no chain re-forward)."""
     cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=4)
     model = TransformerLM(cfg)
     base = run_losses(model, ParallelSpec(), batch)
     f1b = run_losses(model, ParallelSpec(pp=2, tp=2, microbatches=4,
-                                         pp_schedule='1f1b'), batch)
+                                         pp_schedule='1f1b',
+                                         pp_variant=variant), batch)
     assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
 
 
-def test_pipeline_1f1b_ragged_microbatches(batch):
+@pytest.mark.parametrize('variant', ['remat', 'stash'])
+def test_pipeline_1f1b_ragged_microbatches(batch, variant):
     """M % pp may be ragged — even M < pp (round-4: residency slots are
     padded and masked, lifting the round-3 M %% pp == 0 restriction):
-    parity with DP holds at M=2, pp=4."""
+    parity with DP holds at M=2, pp=4, in both backward variants."""
     cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=4)
     model = TransformerLM(cfg)
     base = run_losses(model, ParallelSpec(), batch, steps=2)
     f1b = run_losses(model, ParallelSpec(pp=4, microbatches=2,
-                                         pp_schedule='1f1b'), batch,
+                                         pp_schedule='1f1b',
+                                         pp_variant=variant), batch,
                      steps=2)
     assert np.allclose(f1b, base, atol=2e-4), (f1b, base)
 
 
-def test_fused_1f1b_direct_no_head():
+@pytest.mark.parametrize('variant', ['remat', 'stash'])
+def test_fused_1f1b_direct_no_head(variant):
     """Direct pipeline API, fused mode WITHOUT a head (float x enters
     the pipe, loss folded in the tail): gradients for blocks, tail
-    params, and x itself match the single-stage (pp=1) reference. This
-    is the stash_h-only backward path (no pre-head stash)."""
+    params, and x itself match the single-stage (pp=1) reference —
+    EXACT cotangent scaling, in both backward variants (an e2e loss
+    parity test once missed a 1/pp block-grad bug this catches)."""
     from autodist_tpu.parallel.pipeline import one_f_one_b
 
     pp, M, mb, dim = 2, 4, 2, 8
@@ -131,7 +139,8 @@ def test_fused_1f1b_direct_no_head():
                 # local shard of the stage-stacked params: [1, L, ...]
                 out, _ = one_f_one_b(
                     block_fn, sp__['w'][0], x__, 'pipe', M,
-                    tail_fn=tail_fn, extra=tgt_, tail_params=tp__)
+                    tail_fn=tail_fn, extra=tgt_, tail_params=tp__,
+                    variant=variant)
                 return out
 
             mapped = jax.shard_map(
@@ -188,11 +197,12 @@ def test_pipeline_1f1b_reduces_peak_memory():
     big = {'tokens': rng.randint(0, 4096, (32, 128)),
            'targets': rng.randint(0, 4096, (32, 128))}
 
-    def temp_bytes(schedule, microbatches):
+    def temp_bytes(schedule, microbatches, variant='remat'):
         tr = Trainer(model, _optax.sgd(0.1),
                      spec=ParallelSpec(pp=4, dp=1,
                                        microbatches=microbatches,
-                                       pp_schedule=schedule))
+                                       pp_schedule=schedule,
+                                       pp_variant=variant))
         state = tr.init(jax.random.PRNGKey(0))
         compiled = tr.compile_step(state, big)
         return compiled.memory_analysis().temp_size_in_bytes
@@ -204,6 +214,11 @@ def test_pipeline_1f1b_reduces_peak_memory():
     # count must not grow the working set materially (>15%)
     f1b_m8 = temp_bytes('1f1b', 8)
     assert f1b_bytes < 1.15 * f1b_m8, (f1b_bytes, f1b_m8)
+    # the stash variant trades that M-independence for fewer recompute
+    # passes: still well under GPipe (one boundary activation per
+    # microbatch vs GPipe's per-layer residual stacks)
+    stash_bytes = temp_bytes('1f1b', 16, variant='stash')
+    assert stash_bytes < gpipe_bytes, (stash_bytes, gpipe_bytes)
 
 
 def test_moe_aux_loss_kept_under_pipelining(batch):
@@ -216,6 +231,25 @@ def test_moe_aux_loss_kept_under_pipelining(batch):
     base = run_losses(model, ParallelSpec(), batch)
     pp = run_losses(model, ParallelSpec(pp=2, microbatches=1), batch)
     assert np.allclose(pp, base, atol=3e-4), (pp, base)
+
+
+@pytest.mark.parametrize('variant', ['remat', 'stash'])
+def test_moe_aux_loss_through_fused_1f1b(batch, variant):
+    """The aux cotangent path through BOTH fused-1F1B backwards: with a
+    nonzero router balance loss, multi-step training (losses depend on
+    step-1 gradients, incl. the aux term's router gradients) matches DP
+    — a dropped validity mask double-counting bubble-step aux grads
+    would break the second step."""
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, n_layers=2,
+                                 moe_experts=4, moe_aux_coef=1.0)
+    model = TransformerLM(cfg)
+    base = run_losses(model, ParallelSpec(), batch)
+    # microbatches=1: per-microbatch routing groups coincide with the
+    # full-batch statistic only there (GShard grouping, see gpipe doc)
+    f1b = run_losses(model, ParallelSpec(pp=2, microbatches=1,
+                                         pp_schedule='1f1b',
+                                         pp_variant=variant), batch)
+    assert np.allclose(f1b, base, atol=3e-4), (f1b, base)
     # the aux term is genuinely nonzero (the parity above is meaningful)
     params = model.init(jax.random.PRNGKey(0))
     _, aux = model.per_token_loss_with_aux(
