@@ -83,7 +83,7 @@
 //   PING                         -> PONG
 //   SHUTDOWN                     -> OK (server exits)
 //
-// Build: g++ -O2 -std=c++17 -pthread -o coord_service coord_service.cc
+// Build: g++ -O3 -std=c++17 -pthread -o coord_service coord_service.cc
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -104,6 +104,7 @@
 #include <random>
 #include <sstream>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <vector>
 
@@ -352,15 +353,20 @@ bool constant_time_eq(const std::string& a, const std::string& b) {
 
 // -- wire dtypes -------------------------------------------------------------
 
+// Branch-free (a select, not a branch) so the element loops in
+// encode_wire/decode_wire auto-vectorize — the conversion competes
+// with socket I/O for the same cores under multi-worker contention,
+// where a scalar loop measurably cost more than the bf16 byte saving
+// bought (BASELINE.md round-4 bf16 row, fixed round 5).
 uint16_t f32_to_bf16(float f) {
   uint32_t u;
   memcpy(&u, &f, 4);
-  // NaN first: rtne rounding would carry a small-mantissa NaN into Inf
-  if ((u & 0x7fffffffu) > 0x7f800000u)
-    return static_cast<uint16_t>((u >> 16) | 0x0040);  // quiet NaN
-  // round-to-nearest-even, like XLA's f32->bf16 convert
+  // round-to-nearest-even, like XLA's f32->bf16 convert; NaN must not
+  // round into Inf, so select the quieted-NaN form instead
   uint32_t bias = 0x7fff + ((u >> 16) & 1);
-  return static_cast<uint16_t>((u + bias) >> 16);
+  uint16_t rtne = static_cast<uint16_t>((u + bias) >> 16);
+  uint16_t qnan = static_cast<uint16_t>((u >> 16) | 0x0040);
+  return (u & 0x7fffffffu) > 0x7f800000u ? qnan : rtne;
 }
 
 float bf16_to_f32(uint16_t h) {
@@ -372,7 +378,7 @@ float bf16_to_f32(uint16_t h) {
 
 // wire "f32": payload is raw little-endian float32; "bf16": raw uint16
 // upper halves of float32. Returns false on a malformed payload.
-bool decode_wire(const std::string& payload, const std::string& wire,
+bool decode_wire(std::string_view payload, const std::string& wire,
                  std::vector<float>* out) {
   if (wire == "f32") {
     if (payload.size() % 4) return false;
@@ -477,7 +483,7 @@ bool read_range(std::istringstream* in, size_t n_elems, size_t* off,
 // Handles one request. `payload` holds the request's raw bytes (B*
 // commands); a BGET reply's bytes land in `reply_payload` and follow the
 // returned header line on the wire.
-std::string handle(const std::string& line, const std::string& payload,
+std::string handle(const std::string& line, std::string_view payload,
                    std::string* reply_payload) {
   std::istringstream in(line);
   std::string cmd;
@@ -857,10 +863,13 @@ void serve_conn(int fd) {
       }
       buf.append(chunk, n);
     }
-    std::string payload = buf.substr(0, need);
-    buf.erase(0, need);
+    // zero-copy payload view into the connection buffer (a 100 MB push
+    // used to pay a full substr copy here); handle() is synchronous,
+    // and the buffer is erased only after it returns
+    std::string_view payload(buf.data(), need);
     std::string reply_payload;
     std::string resp = handle(line, payload, &reply_payload) + "\n";
+    buf.erase(0, need);
     if (!send_all(fd, resp.data(), resp.size()) ||
         (!reply_payload.empty() &&
          !send_all(fd, reply_payload.data(), reply_payload.size()))) {

@@ -25,8 +25,23 @@ def _src_path(name):
 def build(source_name, output_name=None, shared=False, extra_flags=()):
     """Compile ``native/<source_name>`` and return the artifact path."""
     src = _src_path(source_name)
+    # -O3: the data-plane element loops (bf16 wire conversion, BADD
+    # accumulate, BSTEP update rules) need the auto-vectorizer, which
+    # gcc enables only at -O3; at -O2 the scalar bf16 loop was slow
+    # enough to erase the wire-byte saving under multi-worker
+    # contention (BASELINE.md bf16 row).
+    cmd = ['g++', '-O3', '-std=c++17', '-pthread']
+    if shared:
+        cmd += ['-shared', '-fPIC']
+    cmd += list(extra_flags)
+    # cache key = source bytes AND the compile command: a flag change
+    # must rebuild byte-identical sources (a warm cache otherwise
+    # silently pins old-flag binaries forever)
+    h = hashlib.sha256()
     with open(src, 'rb') as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    h.update('\x00'.join(cmd).encode())
+    digest = h.hexdigest()[:16]
     out_name = output_name or os.path.splitext(source_name)[0]
     if shared:
         out_name += '.so'
@@ -35,10 +50,7 @@ def build(source_name, output_name=None, shared=False, extra_flags=()):
     if os.path.exists(out):
         return out
     os.makedirs(out_dir, exist_ok=True)
-    cmd = ['g++', '-O2', '-std=c++17', '-pthread']
-    if shared:
-        cmd += ['-shared', '-fPIC']
-    cmd += list(extra_flags) + [src, '-o', out]
+    cmd = cmd + [src, '-o', out]
     logging.info('Building native component: %s', ' '.join(cmd))
     subprocess.run(cmd, check=True)
     return out
