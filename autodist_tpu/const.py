@@ -95,6 +95,12 @@ class ENV(Enum):
     # copied like the strategy) because env assignments ride the remote
     # command line, which is world-readable in `ps` on the worker host.
     AUTODIST_COORD_TOKEN_FILE = (lambda v: v if v else '',)
+    # opt-in space-to-depth stem transform for narrow-channel stride-2
+    # stem convs (measured neutral on v5e — BASELINE.md round-5; kept
+    # for TPU generations where stems bind). Forwarded to launched
+    # workers (coordinator _FORWARDED_FLAGS) so every traced host
+    # agrees — divergent HLO across SPMD hosts deadlocks.
+    AUTODIST_S2D_STEM = (lambda v: (v == 'True' or v == '1'),)
 
     @property
     def val(self):

@@ -21,6 +21,68 @@ import jax.numpy as jnp
 from autodist_tpu.models.core import Dense, Module, ParamDef
 
 
+def _s2d_stem_enabled():
+    """Opt-in gate for the space-to-depth stem transform
+    (``AUTODIST_S2D_STEM=1``). Default OFF: the round-5 A/B measured it
+    NEUTRAL on v5e for ResNet-101/DenseNet-121 and ~1% slower for
+    InceptionV3 (BASELINE.md round-5 s2d section) — XLA's conv emitter
+    already handles the narrow stem; the family's MFU gap lives in the
+    wide mid-network convs, not the one stem conv (~0.5% of FLOPs)."""
+    from autodist_tpu.const import ENV
+    return ENV.AUTODIST_S2D_STEM.val
+
+
+def space_to_depth_conv(x, kernel, stride=2, padding='SAME'):
+    """Stride-2 conv computed in space-to-depth form.
+
+    The classic TPU stem trick (MLPerf ResNet): a k×k stride-2 conv on
+    a narrow-channel input (C=3 pads to 128 MXU lanes, wasting ~97% of
+    the systolic array's contraction dim) is numerically IDENTICAL to a
+    ceil(k/2)×ceil(k/2) stride-1 conv on the 2×2-space-to-depth'd input
+    (C→4C) with correspondingly rearranged weights — same dot products,
+    4× wider contraction, 4× fewer input spatial positions. This is a
+    graph-level rewrite: XLA still emits a plain convolution, no custom
+    kernel, no layout pinning (the round-4 Pallas lesson).
+
+    ``kernel`` is the ORIGINAL [kh, kw, C, O] weights (param shape
+    unchanged — checkpoints and init are oblivious); stride must be 2
+    (the stem case), padding 'SAME' or 'VALID'.
+    """
+    assert stride == 2 and padding in ('SAME', 'VALID')
+    n, h, w, c = x.shape
+    kh, kw, _, o = kernel.shape
+    if padding == 'SAME':
+        out_h, out_w = -(-h // 2), -(-w // 2)
+        pl_h = max((out_h - 1) * 2 + kh - h, 0) // 2
+        pl_w = max((out_w - 1) * 2 + kw - w, 0) // 2
+    else:
+        out_h, out_w = (h - kh) // 2 + 1, (w - kw) // 2 + 1
+        pl_h = pl_w = 0
+    # kernel zero-padded to even extents (zero taps read zero-padded
+    # input — output unchanged); input padded (or cropped: VALID may
+    # discard a tail row the strided windows never covered) so one
+    # VALID pass covers exactly the original window set
+    kh2, kw2 = -(-kh // 2) * 2, -(-kw // 2) * 2
+    in_h, in_w = (out_h - 1) * 2 + kh2, (out_w - 1) * 2 + kw2
+    if in_h - pl_h < h:
+        x = x[:, :in_h - pl_h]
+    if in_w - pl_w < w:
+        x = x[:, :, :in_w - pl_w]
+    x = jnp.pad(x, ((0, 0), (pl_h, max(in_h - x.shape[1] - pl_h, 0)),
+                    (pl_w, max(in_w - x.shape[2] - pl_w, 0)), (0, 0)))
+    k = jnp.pad(kernel, ((0, kh2 - kh), (0, kw2 - kw), (0, 0), (0, 0)))
+    # space-to-depth both operands with matching block order
+    x = x.reshape(n, in_h // 2, 2, in_w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, in_h // 2, in_w // 2, 4 * c)
+    k = k.reshape(kh2 // 2, 2, kw2 // 2, 2, c, o)
+    k = k.transpose(0, 2, 1, 3, 4, 5).reshape(
+        kh2 // 2, kw2 // 2, 4 * c, o)
+    return jax.lax.conv_general_dilated(
+        x, k, window_strides=(1, 1), padding='VALID',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
 class Conv(Module):
     """NHWC conv, HWIO kernel."""
 
@@ -43,10 +105,20 @@ class Conv(Module):
         return d
 
     def apply(self, params, x):
-        y = jax.lax.conv_general_dilated(
-            x.astype(self.dtype), params['kernel'].astype(self.dtype),
-            window_strides=self.stride, padding=self.padding,
-            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        if (self.stride == (2, 2) and
+                self.padding in ('SAME', 'VALID') and
+                self.in_ch <= 4 and _s2d_stem_enabled()):
+            # narrow-channel stride-2 stem: space-to-depth form (same
+            # numbers, MXU-friendlier — see space_to_depth_conv)
+            y = space_to_depth_conv(x.astype(self.dtype),
+                                    params['kernel'].astype(self.dtype),
+                                    padding=self.padding)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x.astype(self.dtype),
+                params['kernel'].astype(self.dtype),
+                window_strides=self.stride, padding=self.padding,
+                dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
         if self.use_bias:
             y = y + params['bias'].astype(self.dtype)
         return y

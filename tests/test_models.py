@@ -55,6 +55,45 @@ def test_vision_models_train_sharded(name):
            lr=lr, require_decrease=(name != 'inception'))
 
 
+@pytest.mark.parametrize('h,k,pad', [
+    (224, 7, 'SAME'),      # ResNet/DenseNet stem
+    (299, 3, 'VALID'),     # InceptionV3 stem
+    (225, 7, 'SAME'),      # odd spatial
+    (230, 4, 'VALID'),     # even kernel
+    (231, 4, 'VALID'),     # even kernel, crop branch (tail row a
+                           # strided window never covers)
+])
+def test_space_to_depth_conv_is_exact(h, k, pad):
+    """The s2d stem rewrite is numerically the SAME conv (same dot
+    products, rearranged): max |diff| at f32 noise level."""
+    from autodist_tpu.models.vision import space_to_depth_conv
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, h, h, 3).astype('f4'))
+    w = jnp.asarray(rng.randn(k, k, 3, 16).astype('f4'))
+    ref = jax.lax.conv_general_dilated(
+        x, w, (2, 2), pad, dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+    got = space_to_depth_conv(x, w, padding=pad)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_s2d_stem_gate_matches_plain_model(monkeypatch):
+    """Full-model forward with the stem flag on vs off: identical
+    (the transform only changes HOW the stem conv is computed)."""
+    from autodist_tpu.models import vision
+    model = vision.ResNet((1, 1), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _image_batch(hw=32)
+    x = jnp.asarray(batch['images'])
+    monkeypatch.setenv('AUTODIST_S2D_STEM', '0')
+    off = model.apply(params, x)
+    monkeypatch.setenv('AUTODIST_S2D_STEM', '1')
+    on = model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                               atol=2e-5)
+
+
 def test_vgg_wrong_spatial_raises():
     from autodist_tpu.models import vision
     model = vision.VGG((8, 'M'), num_classes=5)   # fc sized for 7x7
