@@ -55,8 +55,14 @@ def supports(shape, block=128):
 
 # Measured crossover vs XLA's fused attention on v5e: at short seq the
 # whole score matrix fits on-chip and XLA's fusion wins; the kernel wins
-# once [S, S] spills to HBM (1.2x at 2k, 28x at 8k, fwd+bwd bf16).
-MIN_KERNEL_SEQ = 1024
+# once [S, S] spills to HBM (isolated fwd+bwd bf16: 1.2x at 2k, 28x at
+# 8k). Round-5 END-TO-END check on bert_large (remat, scanned layers)
+# moved the threshold from 1024 to 512: full-model tokens/s at seq 512
+# is ~10% HIGHER with the kernel (34.3k vs 31.0k at B=96) while seq
+# 128/256 strongly favor XLA (45.8k vs 32.6k; 40.2k vs 26.6k) — under
+# remat the attention recompute doubles the [S,S] traffic, which the
+# kernel avoids earlier than the isolated crossover suggested.
+MIN_KERNEL_SEQ = 512
 
 
 def preferred(shape):
