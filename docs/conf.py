@@ -1,0 +1,46 @@
+"""Sphinx configuration (docgen parity with the reference's
+``docs/conf.py`` + autodoc templates).
+
+This image cannot install Sphinx, so CI/users run this where Sphinx
+exists (``pip install -r docs/requirements-docgen.txt``); the
+environment-independent path is ``python tools/gen_api_docs.py``,
+which renders the same docstrings to ``docs/api/`` with the stdlib.
+
+Build: ``sphinx-build -b html docs docs/_build/html``
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath('..'))
+
+project = 'autodist-tpu'
+author = 'autodist-tpu developers'
+
+extensions = [
+    'sphinx.ext.autodoc',
+    'sphinx.ext.autosummary',
+    'sphinx.ext.napoleon',
+    'sphinx.ext.viewcode',
+    'myst_parser',          # the hand-written docs/ pages are markdown
+]
+
+autosummary_generate = True
+autodoc_member_order = 'bysource'
+autodoc_default_options = {
+    'members': True,
+    'undoc-members': False,
+    'show-inheritance': True,
+}
+autodoc_mock_imports = [
+    # heavy/accelerator deps: docs must build on a bare CPU box
+    'jax', 'jaxlib', 'flax', 'optax', 'orbax', 'chex', 'ml_dtypes',
+]
+
+napoleon_google_docstring = True
+napoleon_numpy_docstring = False
+
+source_suffix = {'.rst': 'restructuredtext', '.md': 'markdown'}
+master_doc = 'index'
+exclude_patterns = ['_build', 'api']   # api/ is the stdlib-rendered copy
+
+html_theme = 'alabaster'

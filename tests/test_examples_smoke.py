@@ -34,6 +34,24 @@ def test_image_classifier_zero_touch_example():
     assert min(losses[-3:]) < losses[0], losses
 
 
+def test_api_reference_generator(tmp_path):
+    """`tools/gen_api_docs.py` (the reference docgen pipeline's role)
+    renders every public module's docstrings to markdown."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'gen_api_docs.py'),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    index = (tmp_path / 'index.md').read_text()
+    for mod in ('autodist_tpu.api', 'autodist_tpu.strategy.builders',
+                'autodist_tpu.parallel.pipeline',
+                'autodist_tpu.runtime.session'):
+        assert mod in index, index
+    api = (tmp_path / 'autodist_tpu_api.md').read_text()
+    assert 'class `Trainer`' in api
+
+
 def test_sentiment_classifier_dsl_example():
     out = _run_example('sentiment_classifier.py', '--steps', '20')
     losses = [float(m) for m in
