@@ -36,7 +36,11 @@ class TransformerConfig:
     # LN2+MLP half at one extra [b,s,d] save per layer. On v5e BERT
     # bench shapes the two are perf-equal (step time is dominated
     # elsewhere); 'save_attn' matters when attention is the expensive
-    # recompute (long sequences without the flash kernel).
+    # recompute (long sequences without the flash kernel). Also
+    # 'dots' (save every matmul output — recompute only elementwise
+    # work; highest-memory selective tier, exceeds a 16 GB chip for
+    # bert_large from batch 128) and 'dots_no_batch' (save only
+    # batch-free dots — effectively full remat here). See _block_fn.
     remat: object = False
     scan_layers: bool = True     # stack blocks + lax.scan (1 compile/block)
     # Chunked cross-entropy: target rows (batch*seq positions) per chunk
@@ -180,17 +184,33 @@ class TransformerLM(Module):
         return constrain(x, ('batch', 'seq', 'embed'))
 
     def _block_fn(self):
-        """Single-block apply with the remat policy applied."""
+        """Single-block apply with the remat policy applied.
+
+        ``cfg.remat``: False (no remat), True (full — recompute the
+        whole block in the backward), or a named selective policy:
+        'save_attn' (keep attention outputs), 'dots' (keep every
+        matmul output — recompute only elementwise/norm work; the
+        highest-memory selective tier), 'dots_no_batch' (keep only
+        batch-free dot outputs — in a transformer block effectively
+        full remat, kept for completeness).
+        """
         cfg = self.cfg
         block_fn = self.block.apply
-        if isinstance(cfg.remat, str) and cfg.remat != 'save_attn':
-            raise ValueError('unknown remat mode %r (expected False, '
-                             'True, or \'save_attn\')' % (cfg.remat,))
-        if cfg.remat == 'save_attn':
-            return jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    'attn_out'))
+        if isinstance(cfg.remat, str):
+            policies = {
+                'save_attn':
+                    jax.checkpoint_policies.save_only_these_names(
+                        'attn_out'),
+                'dots': jax.checkpoint_policies.checkpoint_dots,
+                'dots_no_batch':
+                    jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable,
+            }
+            if cfg.remat not in policies:
+                raise ValueError(
+                    'unknown remat mode %r (expected False, True, or '
+                    'one of %s)' % (cfg.remat, sorted(policies)))
+            return jax.checkpoint(block_fn, policy=policies[cfg.remat])
         if cfg.remat:
             return jax.checkpoint(block_fn)
         return block_fn

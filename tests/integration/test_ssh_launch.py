@@ -133,6 +133,9 @@ def _chief_env(tmp_path, resource_file, extra_path=None):
             del env[k]
     env['SYS_RESOURCE_PATH'] = resource_file
     env['AUTODIST_COORD_SERVICE_ADDR'] = '127.0.0.1:%d' % free_port()
+    # a registry tracing flag: must ride the shipped worker command
+    # line (divergent HLO across SPMD hosts deadlocks)
+    env['AUTODIST_S2D_STEM'] = '1'
     env['SHIM_LOG'] = str(tmp_path / 'shim.log')
     if extra_path:
         env['PATH'] = extra_path + os.pathsep + env.get('PATH', '')
@@ -180,6 +183,7 @@ def test_ssh_launch_path_executes(tmp_path):
     assert 'scp' in log and '127.0.0.2' in log, log
     assert 'AUTODIST_WORKER=127.0.0.2' in log, log
     assert 'AUTODIST_STRATEGY_ID=' in log, log
+    assert 'AUTODIST_S2D_STEM=1' in log, log   # registry flag forwarded
     assert 'mv -f' in log, log   # atomic strategy placement
 
 

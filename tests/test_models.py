@@ -152,6 +152,8 @@ def test_chunked_ce_and_remat_modes_match_plain():
         'chunked': dict(loss_chunk=64),
         'save_attn': dict(remat='save_attn', loss_chunk=64),
         'full_remat': dict(remat=True, loss_chunk=64),
+        'dots': dict(remat='dots', loss_chunk=64),
+        'dots_no_batch': dict(remat='dots_no_batch', loss_chunk=64),
     }
     ref_loss = ref_grads = None
     for name, kw in variants.items():

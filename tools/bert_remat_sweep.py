@@ -1,0 +1,66 @@
+"""BERT-large phase-1 remat-policy sweep (round 5 frontier probe).
+
+The 47.5%-MFU point uses FULL per-block remat; round 4's per-op
+profile attributed ~9% of the step to scan-stacking bookkeeping plus
+the full recompute. This sweeps the selective policies ('dots' keeps
+every matmul output — recompute only elementwise work) against full
+remat and no remat at phase-1 and phase-2 shapes. OOM rows are
+recorded as such.
+"""
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench as B
+
+
+def main():
+    from autodist_tpu.utils.jax_env import apply_jax_env_overrides
+    apply_jax_env_overrides()
+
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    peak = B.peak_flops_for(jax.devices()[0])
+    rng = np.random.RandomState(0)
+    cases = [(128, 512), (128, 384), (512, 96)]
+    if len(sys.argv) > 2:
+        cases = [(int(sys.argv[1]), int(sys.argv[2]))]
+    for seq, bs in cases:
+        batch = {'tokens': rng.randint(0, 30522, (bs, seq),
+                                       dtype=np.int32),
+                 'targets': rng.randint(0, 30522, (bs, seq),
+                                        dtype=np.int32)}
+        for remat in (True, 'dots', False):
+            cfg = dataclasses.replace(
+                TransformerConfig.bert_large(dtype=jnp.bfloat16,
+                                             remat=True),
+                remat=remat)
+            label = 's%d_B%d_remat-%s' % (seq, bs, remat)
+            try:
+                stats = {}
+                dt, _ = B.run_workload(TransformerLM(cfg), batch,
+                                       steps=8, stats_out=stats)
+                tps = bs * seq * 8 / dt
+                print(label, json.dumps(
+                    {'tokens_per_s_chip': round(tps, 1),
+                     'mfu_pct': B.mfu_pct(
+                         tps * B.bert_train_flops_per_token(cfg, seq),
+                         peak),
+                     'dispersion_pct': stats['dispersion_pct']}),
+                    flush=True)
+            except Exception as e:   # noqa: BLE001 - OOM rows recorded
+                print(label, json.dumps({'error': str(e)[:160]}),
+                      flush=True)
+
+
+if __name__ == '__main__':
+    main()
