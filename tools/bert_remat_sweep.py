@@ -7,7 +7,6 @@ every matmul output — recompute only elementwise work) against full
 remat and no remat at phase-1 and phase-2 shapes. OOM rows are
 recorded as such.
 """
-import dataclasses
 import json
 import os
 import sys
@@ -31,25 +30,26 @@ def main():
 
     peak = B.peak_flops_for(jax.devices()[0])
     rng = np.random.RandomState(0)
+    steps = 8
     cases = [(128, 512), (128, 384), (512, 96)]
-    if len(sys.argv) > 2:
+    if len(sys.argv) == 3:        # usage: bert_remat_sweep.py SEQ BATCH
         cases = [(int(sys.argv[1]), int(sys.argv[2]))]
+    elif len(sys.argv) != 1:
+        sys.exit('usage: bert_remat_sweep.py [SEQ BATCH]')
     for seq, bs in cases:
-        batch = {'tokens': rng.randint(0, 30522, (bs, seq),
-                                       dtype=np.int32),
-                 'targets': rng.randint(0, 30522, (bs, seq),
-                                        dtype=np.int32)}
         for remat in (True, 'dots', False):
-            cfg = dataclasses.replace(
-                TransformerConfig.bert_large(dtype=jnp.bfloat16,
-                                             remat=True),
-                remat=remat)
+            cfg = TransformerConfig.bert_large(dtype=jnp.bfloat16,
+                                               remat=remat)
+            batch = {'tokens': rng.randint(0, cfg.vocab, (bs, seq),
+                                           dtype=np.int32),
+                     'targets': rng.randint(0, cfg.vocab, (bs, seq),
+                                            dtype=np.int32)}
             label = 's%d_B%d_remat-%s' % (seq, bs, remat)
             try:
                 stats = {}
                 dt, _ = B.run_workload(TransformerLM(cfg), batch,
-                                       steps=8, stats_out=stats)
-                tps = bs * seq * 8 / dt
+                                       steps=steps, stats_out=stats)
+                tps = bs * seq * steps / dt
                 print(label, json.dumps(
                     {'tokens_per_s_chip': round(tps, 1),
                      'mfu_pct': B.mfu_pct(
