@@ -17,7 +17,11 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument('--config', default='tiny',
                    choices=['tiny', 'gpt_small', 'bert_large'])
-    p.add_argument('--batch', type=int, default=8)
+    p.add_argument('--batch', type=int, default=8,
+                   help='per-chip batch. Measured v5e optima for '
+                        'bert_large: 224 at seq 128 (phase 1), 96 at '
+                        'seq 512 (phase 2); non-monotonic landscape '
+                        '(BASELINE.md round-5)')
     p.add_argument('--seq', type=int, default=None)
     p.add_argument('--steps', type=int, default=10)
     p.add_argument('--lr', type=float, default=1e-4)

@@ -93,7 +93,11 @@ def main():
     p.add_argument('--model', default='resnet101',
                    choices=['resnet50', 'resnet101', 'resnet152', 'vgg16',
                             'densenet121', 'inception'])
-    p.add_argument('--batch', type=int, default=64)
+    p.add_argument('--batch', type=int, default=64,
+                   help='per-chip batch. Measured v5e optima: 256 for '
+                        'resnet101/densenet121/vgg16/inception; the '
+                        'landscape is NON-monotonic (BASELINE.md '
+                        'round-5) — sweep down as well as up')
     p.add_argument('--steps', type=int, default=20)
     p.add_argument('--lr', type=float, default=0.1)
     p.add_argument('--tiny', action='store_true',
