@@ -101,6 +101,12 @@ class ENV(Enum):
     # workers (coordinator _FORWARDED_FLAGS) so every traced host
     # agrees — divergent HLO across SPMD hosts deadlocks.
     AUTODIST_S2D_STEM = (lambda v: (v == 'True' or v == '1'),)
+    # opt-in DenseNet dense-block form: preallocated buffer +
+    # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
+    # copy traffic; exactness tested, on-chip A/B pending — see
+    # BASELINE.md). Forwarded like the other tracing flags: divergent
+    # HLO across SPMD hosts deadlocks.
+    AUTODIST_DENSENET_DUS = (lambda v: (v == 'True' or v == '1'),)
 
     @property
     def val(self):

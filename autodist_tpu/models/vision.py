@@ -32,6 +32,13 @@ def _s2d_stem_enabled():
     return ENV.AUTODIST_S2D_STEM.val
 
 
+def _densenet_dus_enabled():
+    """Opt-in gate for the DenseNet buffer/dynamic-update-slice block
+    form (``AUTODIST_DENSENET_DUS=1``); see DenseNet._apply_dus."""
+    from autodist_tpu.const import ENV
+    return ENV.AUTODIST_DENSENET_DUS.val
+
+
 def space_to_depth_conv(x, kernel, stride=2, padding='SAME'):
     """Stride-2 conv computed in space-to-depth form.
 
@@ -531,29 +538,26 @@ class DenseLayer(Module):
         return {'bn1': self.bn1, 'conv1': self.conv1,
                 'bn2': self.bn2, 'conv2': self.conv2}
 
-    def apply(self, params, x):
+    def growth_out(self, params, x):
+        """The layer's NEW features only ([..., growth] — no concat):
+        the caller decides how to append them (concat, or a
+        dynamic-update-slice into a preallocated block buffer)."""
         if _fused_conv_enabled() and _fused_pointwise_ok(self.conv1, x):
-            return self._apply_fused(params, x)
+            dt = self.conv1.dtype
+            a1, b1 = self.bn1.coeffs(params['bn1'], x)
+            y, (a2, b2) = _pointwise_raw_coeffs(
+                self.conv1, self.bn2, params['conv1'], params['bn2'], x,
+                prologue=(a1, b1, True))
+            yn = _fold(y, a2, b2, dt, relu=True)
+            return self.conv2.apply(params['conv2'], yn)
         y = self.conv1.apply(params['conv1'], jax.nn.relu(
             self.bn1.apply(params['bn1'], x)))
-        y = self.conv2.apply(params['conv2'], jax.nn.relu(
+        return self.conv2.apply(params['conv2'], jax.nn.relu(
             self.bn2.apply(params['bn2'], y)))
-        return jnp.concatenate([x, y], axis=-1)
 
-    def _apply_fused(self, params, x):
-        """Pre-activation dense layer on the fused kernel: bn1's
-        normalize+ReLU folds into conv1's PROLOGUE (no elementwise pass
-        over the ever-growing concat tensor — DenseNet's dominant
-        activation cost) and bn2's moments come from conv1's epilogue
-        (no stats pass over the bottleneck output)."""
-        dt = self.conv1.dtype
-        a1, b1 = self.bn1.coeffs(params['bn1'], x)
-        y, (a2, b2) = _pointwise_raw_coeffs(
-            self.conv1, self.bn2, params['conv1'], params['bn2'], x,
-            prologue=(a1, b1, True))
-        yn = _fold(y, a2, b2, dt, relu=True)
-        y = self.conv2.apply(params['conv2'], yn)
-        return jnp.concatenate([x, y], axis=-1)
+    def apply(self, params, x):
+        return jnp.concatenate([x, self.growth_out(params, x)],
+                               axis=-1)
 
 
 class DenseNet(Module):
@@ -590,10 +594,52 @@ class DenseNet(Module):
     def apply(self, params, x):
         y = self.stem.apply(params['stem'], x)
         y = max_pool(y, 3, 2)
+        if _densenet_dus_enabled():
+            return self._apply_dus(params, y)
         for i, (kind, m) in enumerate(self.layers):
             y = m.apply(params['layer_%03d' % i], y)
             if kind == 'trans':
                 y = avg_pool(y, 2, 2, 'VALID')
+        y = jax.nn.relu(self.bn_f.apply(params['bn_f'], y))
+        y = global_avg_pool(y)
+        return self.head.apply(params['head'], y).astype(jnp.float32)
+
+    def _apply_dus(self, params, y):
+        """Dense blocks via a preallocated buffer + dynamic-update-slice
+        (AUTODIST_DENSENET_DUS=1): per layer only the ``growth`` new
+        channels are WRITTEN, where the concat form rewrites the whole
+        accumulated feature map — O(L) vs O(L^2) copy traffic per
+        block. Numerically identical (buffer[..., :ch] == the concat
+        prefix at every step; reads are unavoidable either way)."""
+        i = 0
+        n = len(self.layers)
+        while i < n:
+            kind, m = self.layers[i]
+            if kind == 'trans':
+                y = m.apply(params['layer_%03d' % i], y)
+                y = avg_pool(y, 2, 2, 'VALID')
+                i += 1
+                continue
+            # a run of dense layers: preallocate the block's final width
+            run = 0
+            while i + run < n and self.layers[i + run][0] == 'dense':
+                run += 1
+            ch = y.shape[-1]
+            growth = self.layers[i][1].conv2.out_ch
+            buf = jnp.zeros(y.shape[:-1] + (ch + growth * run,),
+                            y.dtype)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, y, 0, axis=-1)
+            for j in range(run):
+                _, layer = self.layers[i + j]
+                x_in = jax.lax.slice_in_dim(buf, 0, ch, axis=-1)
+                new = layer.growth_out(
+                    params['layer_%03d' % (i + j)], x_in)
+                buf = jax.lax.dynamic_update_slice_in_dim(
+                    buf, new.astype(buf.dtype), ch, axis=-1)
+                ch += growth
+            y = buf
+            i += run
         y = jax.nn.relu(self.bn_f.apply(params['bn_f'], y))
         y = global_avg_pool(y)
         return self.head.apply(params['head'], y).astype(jnp.float32)

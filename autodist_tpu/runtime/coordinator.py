@@ -27,7 +27,7 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_HEARTBEAT_TIMEOUT,
                     ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
                     ENV.AUTODIST_PS_CHUNK_BYTES,
-                    ENV.AUTODIST_S2D_STEM,
+                    ENV.AUTODIST_S2D_STEM, ENV.AUTODIST_DENSENET_DUS,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 # AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
 # assignments ride the remote ssh command line, which is world-readable

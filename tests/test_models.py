@@ -94,6 +94,31 @@ def test_s2d_stem_gate_matches_plain_model(monkeypatch):
                                atol=2e-5)
 
 
+def test_densenet_dus_block_form_is_exact(monkeypatch):
+    """The buffer/dynamic-update-slice dense-block form
+    (AUTODIST_DENSENET_DUS=1) is numerically the SAME model: outputs
+    and gradients match the concat form exactly (buffer[..., :ch] ==
+    the concat prefix at every layer)."""
+    from autodist_tpu.models import vision
+    model = vision.DenseNet((2, 2), num_classes=4)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {'images': rng.rand(2, 32, 32, 3).astype('f4'),
+             'labels': np.array([1, 2], np.int32)}
+    x = jnp.asarray(batch['images'])
+    monkeypatch.setenv('AUTODIST_DENSENET_DUS', '0')
+    plain = model.apply(params, x)
+    g0 = jax.grad(model.loss)(params, batch)
+    monkeypatch.setenv('AUTODIST_DENSENET_DUS', '1')
+    dus = model.apply(params, x)
+    g1 = jax.grad(model.loss)(params, batch)
+    np.testing.assert_allclose(np.asarray(dus), np.asarray(plain),
+                               atol=1e-5)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5)
+
+
 def test_vgg_wrong_spatial_raises():
     from autodist_tpu.models import vision
     model = vision.VGG((8, 'M'), num_classes=5)   # fc sized for 7x7
