@@ -299,11 +299,12 @@ class Trainer:
                 all(isinstance(a, (str, type(None))) for a in x)))
         sp_on = AXIS_SEQUENCE in manual
         batch_spec = P(None, AXIS_SEQUENCE) if sp_on else P()
-        mapped = jax.shard_map(
-            per_token, mesh=self.mesh,
-            in_specs=(param_specs, batch_spec),
-            out_specs=(P(None, AXIS_SEQUENCE) if sp_on else P(), P()),
-            axis_names=set(manual), check_vma=False)
+        from autodist_tpu.parallel.axes import shard_map_compat
+        mapped = shard_map_compat(
+            per_token, self.mesh,
+            (param_specs, batch_spec),
+            (P(None, AXIS_SEQUENCE) if sp_on else P(), P()),
+            axis_names=set(manual))
         nll, aux = mapped(params, batch)
         mask = batch.get('mask') if hasattr(batch, 'get') else None
         if mask is not None:

@@ -43,6 +43,19 @@ AUTODIST_TO_DELETE_SCOPE = 'to-delete'
 
 MAX_INT32 = 2 ** 31 - 1
 
+# Gradient-bucketing defaults. A merged AllReduce group is packed into
+# byte-capped buckets (parallel/plan.py pack_buckets) so the first
+# bucket's collective issues while earlier layers' backward compute is
+# still producing gradients, instead of one model-sized concat that
+# serializes behind the whole backward pass. The cap derives from the
+# strategy's ``chunk_size`` (tensors per merged group, the reference
+# AllReduce knob) at BUCKET_BYTES_PER_CHUNK each — the default
+# 128 * 256 KiB = 32 MiB sits in the band where TPU ICI is
+# bandwidth-bound rather than latency-bound. ``AUTODIST_BUCKET_BYTES``
+# overrides the cap directly.
+DEFAULT_CHUNK_SIZE = 128
+BUCKET_BYTES_PER_CHUNK = 256 << 10
+
 
 class ENV(Enum):
     """Typed environment flags, each with a default-producing lambda.
@@ -101,6 +114,17 @@ class ENV(Enum):
     # workers (coordinator _FORWARDED_FLAGS) so every traced host
     # agrees — divergent HLO across SPMD hosts deadlocks.
     AUTODIST_S2D_STEM = (lambda v: (v == 'True' or v == '1'),)
+    # byte cap for fused gradient all-reduce buckets (0 = derive from
+    # the strategy's chunk_size; see const.BUCKET_BYTES_PER_CHUNK).
+    AUTODIST_BUCKET_BYTES = (lambda v: int(v) if v else 0,)
+    # XLA overlap flags (latency-hiding scheduler + async collectives,
+    # runtime/session.py setup) are enabled when gradient bucketing is
+    # active; '0'/'False' opts out.
+    AUTODIST_XLA_OVERLAP = (lambda v: not (v == '0' or v == 'False'),)
+    # PS data plane torn-read retry budget (coord_client.vget): attempt
+    # cap and base backoff for reads raced by concurrent pushes.
+    AUTODIST_PS_TORN_RETRIES = (lambda v: int(v) if v else 100,)
+    AUTODIST_PS_TORN_BACKOFF_S = (lambda v: float(v) if v else 0.01,)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

@@ -105,9 +105,9 @@ class MultiHeadAttention(Module):
                  heads_axis)
         names = {a for a in (AXIS_DATA, heads_axis)
                  if a and mesh.shape.get(a, 1) > 1}
-        fn = jax.shard_map(
+        from autodist_tpu.parallel.axes import shard_map_compat
+        fn = shard_map_compat(
             lambda q, k, v: fa.flash_attention(q, k, v,
                                                causal=self.causal),
-            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
-            axis_names=names, check_vma=False)
+            mesh, (spec,) * 3, spec, axis_names=names)
         return fn(q, k, v)

@@ -44,20 +44,26 @@ def sharded_embedding_lookup(table, ids, axis):
 
     if manual_axis(axis):
         return masked(table, ids)
-    ctx_mesh = jax.sharding.get_abstract_mesh()
-    if ctx_mesh.shape:
-        # already inside a manual region where the vocab axis stays auto:
-        # shardy rejects a nested shard_map re-entering those axes, so
-        # fall back to the one-hot matmul (partitions cleanly under GSPMD
-        # and runs on the MXU).
+    try:
+        in_auto_ctx = bool(jax.sharding.get_abstract_mesh().shape)
+        partial_manual = hasattr(jax, 'shard_map')
+    except AttributeError:   # older jax: no mesh-context introspection
+        in_auto_ctx, partial_manual = False, False
+    if in_auto_ctx or not partial_manual:
+        # already inside a manual region where the vocab axis stays auto
+        # (shardy rejects a nested shard_map re-entering those axes), or
+        # a jax without partial-manual shard_map: fall back to the
+        # one-hot matmul (partitions cleanly under GSPMD and runs on
+        # the MXU).
         vocab = table.shape[0]
         oh = jax.nn.one_hot(ids, vocab, dtype=table.dtype)
         return oh @ table
     from jax.sharding import PartitionSpec as P
-    return jax.shard_map(
-        masked, mesh=current_mesh(), axis_names={axis},
-        in_specs=(P(axis), P()), out_specs=P(),
-        check_vma=False)(table, ids)
+
+    from autodist_tpu.parallel.axes import shard_map_compat
+    return shard_map_compat(
+        masked, current_mesh(), (P(axis), P()), P(),
+        axis_names={axis})(table, ids)
 
 
 @dataclass

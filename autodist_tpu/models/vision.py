@@ -626,6 +626,17 @@ class DenseNet(Module):
                 run += 1
             ch = y.shape[-1]
             growth = self.layers[i][1].conv2.out_ch
+            # the buffer is sized from the FIRST layer's growth; a
+            # heterogeneous-growth block would silently clamp later
+            # layers' writes into a too-small buffer — refuse instead
+            growths = [self.layers[i + j][1].conv2.out_ch
+                       for j in range(run)]
+            if any(g != growth for g in growths):
+                raise ValueError(
+                    'AUTODIST_DENSENET_DUS requires every dense layer '
+                    'in a block to share conv2.out_ch (growth); got %s '
+                    'for layers %d..%d — use the concat form for '
+                    'heterogeneous growth' % (growths, i, i + run - 1))
             buf = jnp.zeros(y.shape[:-1] + (ch + growth * run,),
                             y.dtype)
             buf = jax.lax.dynamic_update_slice_in_dim(

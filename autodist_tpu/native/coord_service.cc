@@ -187,17 +187,43 @@ std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
   return t;
 }
 
-// A frame rejected before its tensor lock (bad payload / bad range)
-// still aborts the sequence its writer opened at offset 0 — otherwise
-// one malformed chunk would wedge the key's readers on a permanently-
-// odd version until DELNS removes the tensor.
-std::string abort_open_seq(const std::string& key, const char* e) {
+// A CONTINUATION frame (declared offset > 0) rejected before its tensor
+// lock (bad payload / bad range) still aborts the sequence its writer
+// opened at offset 0 — otherwise one malformed chunk would wedge the
+// key's readers on a permanently-odd version until DELNS removes the
+// tensor. Only continuation chunks qualify: an offset-0 (or offsetless,
+// or unparsable-offset) frame rejected here never opened a sequence —
+// SeqFrame is constructed after these checks — so decrementing for it
+// would close ANOTHER writer's in-flight chunked sequence and clear the
+// torn-read parity bit under that writer's feet. `off_declared` is the
+// frame's raw declared offset (-1 when absent/unparsable).
+std::string abort_open_seq(const std::string& key, int64_t off_declared,
+                           const char* e) {
+  if (off_declared <= 0) return e;
   std::shared_ptr<Tensor> t = find_tensor(key, /*create=*/false);
   if (t) {
     std::lock_guard<std::mutex> l(t->mu);
     if (t->open_writes > 0) --t->open_writes;
   }
   return e;
+}
+
+// The raw declared offset of a B* command's optional trailing
+// `<off> <total>` range, parsed WITHOUT validation (the frame is
+// already being rejected; this only decides whether it could have been
+// a continuation chunk of an open sequence). -1 when absent or
+// unparsable. Restores the stream position so read_range (in the
+// accept path) is unaffected.
+int64_t declared_offset(std::istringstream* in) {
+  in->clear();   // a rangeless header leaves eofbit set from the parse
+  std::streampos pos = in->tellg();
+  int64_t o = -1;
+  // parse the offset ALONE: a continuation frame whose total token is
+  // corrupt ("5 garbage") must still abort its own open sequence
+  if (!(*in >> o)) o = -1;
+  in->clear();
+  if (pos != std::streampos(-1)) in->seekg(pos);
+  return o;
 }
 
 // -- sha256 / hmac (handshake) -----------------------------------------------
@@ -595,12 +621,13 @@ std::string handle(const std::string& line, std::string_view payload,
     std::string k, wire;
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
+    const int64_t off_decl = declared_offset(&in);
     std::vector<float> vals;
     if (!decode_wire(payload, wire, &vals))
-      return abort_open_seq(k, "ERR bad payload");
+      return abort_open_seq(k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, vals.size(), &off, &total))
-      return abort_open_seq(k, "ERR bad range");
+      return abort_open_seq(k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
     SeqFrame seq(t.get(), off);
@@ -668,12 +695,13 @@ std::string handle(const std::string& line, std::string_view payload,
     std::string k, wire;
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
+    const int64_t off_decl = declared_offset(&in);
     std::vector<float> delta;
     if (!decode_wire(payload, wire, &delta))
-      return abort_open_seq(k, "ERR bad payload");
+      return abort_open_seq(k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, delta.size(), &off, &total))
-      return abort_open_seq(k, "ERR bad range");
+      return abort_open_seq(k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
     SeqFrame seq(t.get(), off);
@@ -691,12 +719,13 @@ std::string handle(const std::string& line, std::string_view payload,
     int64_t t_in = 0;
     double p0 = 0, p1 = 0, p2 = 0, p3 = 0;
     in >> k >> nbytes >> wire >> rule >> t_in >> p0 >> p1 >> p2 >> p3;
+    const int64_t off_decl = declared_offset(&in);
     std::vector<float> grad;
     if (!decode_wire(payload, wire, &grad))
-      return abort_open_seq(k, "ERR bad payload");
+      return abort_open_seq(k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, grad.size(), &off, &total))
-      return abort_open_seq(k, "ERR bad range");
+      return abort_open_seq(k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "ERR no tensor";
     std::lock_guard<std::mutex> l(t->mu);

@@ -222,6 +222,52 @@ class sharding_ctx:
          _CTX.options) = self._old
 
 
+def axis_size(axis_name):
+    """``lax.axis_size`` across jax versions: older jax has no such
+    helper, but ``psum(1, axis)`` constant-folds to the axis size."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return int(jax.lax.psum(1, axis_name))
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, axis_names=None):
+    """Partial-manual shard_map across jax spellings.
+
+    jax>=0.6 exposes ``jax.shard_map`` with ``axis_names=`` (the manual
+    set) and ``check_vma``; older jax spells the manual set as its
+    complement ``auto=`` on ``jax.experimental.shard_map.shard_map``
+    and the flag ``check_rep``. Replication checking is off either way
+    (these regions mix manual collectives with replicated outputs).
+    """
+    import jax as _jax
+    if hasattr(_jax, 'shard_map'):
+        kw = {}
+        if axis_names is not None:
+            kw['axis_names'] = set(axis_names)
+        try:
+            return _jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_vma=False,
+                                  **kw)
+        except TypeError:   # pragma: no cover - intermediate jax
+            return _jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=False,
+                                  **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    if axis_names is not None:
+        # old jax's partial-auto shard_map (auto=) lowers these regions
+        # to PartitionId crashes — often after a multi-minute doomed
+        # compile. Refuse up front with an actionable error instead:
+        # the functional partial-manual paths need jax>=0.6.
+        raise NotImplementedError(
+            'partial-manual shard_map over %s needs jax>=0.6 '
+            '(jax.shard_map axis_names=); this jax has only the '
+            'experimental fully-manual shard_map'
+            % sorted(axis_names))
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def manual_axis(mesh_axis):
     """The live manual (shard_map) axis name, or None.
 

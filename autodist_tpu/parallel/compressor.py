@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from autodist_tpu.const import AXIS_DATA
+from autodist_tpu.parallel.axes import axis_size
 
 _REGISTRY = {}
 
@@ -112,7 +113,7 @@ def int8_ring_all_reduce(x, axis_name):
     requantization keeps the growing partial sums in range (the EQuARX
     recipe); callers carry an error-feedback residual for unbiasedness.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     shape = x.shape
@@ -172,7 +173,7 @@ class Int8RingCompressor(Compressor):
         q, scale = _quantize_int8(compensated)
         transmitted = q.astype(jnp.float32) * scale
         env.aux_updates[key] = {'residual': compensated - transmitted}
-        n = jax.lax.axis_size(AXIS_DATA)
+        n = axis_size(AXIS_DATA)
         return int8_ring_all_reduce(transmitted, AXIS_DATA) / n
 
 

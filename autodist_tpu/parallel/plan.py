@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import AXIS_DATA
+from autodist_tpu.const import (AXIS_DATA, BUCKET_BYTES_PER_CHUNK,
+                                DEFAULT_CHUNK_SIZE, ENV)
 from autodist_tpu.kernels.partitioner import PartitionerConfig
 from autodist_tpu.parallel import compressor as comp
 from autodist_tpu.strategy.base import (AllReduceSynchronizer,
@@ -48,7 +49,8 @@ def ring_all_reduce(x, axis_name):
     does better, so this only runs when forced. Wire volume is pinned by
     ``tests/test_hlo_collectives.py`` against the compiled HLO.
     """
-    n = jax.lax.axis_size(axis_name)
+    from autodist_tpu.parallel.axes import axis_size
+    n = axis_size(axis_name)
     if n == 1:
         return x
     shape = x.shape
@@ -72,6 +74,46 @@ def ring_all_reduce(x, axis_name):
     # device row j holds chunk (j+1)%n -> chunk c sits at row (c-1)%n
     full = full[jnp.asarray([(c - 1) % n for c in range(n)])]
     return full.reshape(-1)[:x.size].reshape(shape)
+
+
+def bucket_bytes_cap(chunk_size=0):
+    """Per-bucket byte cap for fused gradient collectives.
+
+    ``AUTODIST_BUCKET_BYTES`` overrides directly; otherwise the cap
+    derives from the strategy's ``chunk_size`` (tensors per merged
+    group) at ``BUCKET_BYTES_PER_CHUNK`` each, so the reference knob
+    keeps meaning something at modern model sizes: a group is never
+    fused into one model-sized concat, it is packed into byte-capped
+    buckets whose collectives can overlap the backward pass.
+    """
+    cap = ENV.AUTODIST_BUCKET_BYTES.val
+    if cap:
+        return max(1, cap)
+    return (chunk_size or DEFAULT_CHUNK_SIZE) * BUCKET_BYTES_PER_CHUNK
+
+
+def pack_buckets(items, cap_bytes, max_vars=0):
+    """Greedy contiguous packing of ``[(key, nbytes)]`` into buckets.
+
+    Pure and deterministic (the same inputs produce the same buckets on
+    every process — divergent bucket layouts across SPMD hosts would
+    deadlock the collective). A bucket closes when adding the next item
+    would exceed ``cap_bytes`` (an item larger than the cap still gets
+    a bucket of its own) or when it already holds ``max_vars`` items
+    (0 = unbounded). Returns ``[[key, ...], ...]`` in input order.
+    """
+    buckets = []
+    cur, cur_bytes = [], 0
+    for key, nbytes in items:
+        if cur and (cur_bytes + nbytes > cap_bytes or
+                    (max_vars and len(cur) >= max_vars)):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(key)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 class ShardedGrad:
@@ -124,10 +166,12 @@ class VarPlan:
             self.compressor = comp.create(self.sync.compressor, var.name)
             self.group = self.sync.group
             self.spec = self.sync.spec
+            self.chunk_size = getattr(self.sync, 'chunk_size', 0)
         else:
             self.compressor = comp.create('NoneCompressor', var.name)
             self.group = None
             self.spec = 'AUTO'
+            self.chunk_size = 0
         # ZeRO-style state sharding applies to partitioned vars; when the
         # partition axis does not divide the mesh data axis (the uneven
         # case, UnevenPartitionedPS) the physical state is zero-padded to
@@ -180,6 +224,12 @@ class ExecutionPlan:
         self.max_staleness = max(
             [p.staleness for p in self.var_plans.values()] + [0])
         self._pure_sparse_cache = {}
+        # per-bucket accounting from the most recent sync_gradients
+        # trace: [{'kind', 'group', 'compressor', 'dtype', 'spec',
+        # 'vars', 'bytes'}] — surfaced by bench.py and
+        # utils/profiling.bucket_report so the bucket layout (and the
+        # overlap it enables) is auditable without reading HLO.
+        self.last_bucket_stats = []
         # loose-mode gate: any sync=True var demands its staleness bound;
         # the program-wide gate enforces the tightest one (per-variable
         # windows collapse to one window since the step is one program).
@@ -310,21 +360,75 @@ class ExecutionPlan:
             (all_ids, all_rows))
         return ShardedGrad(acc / n, 0, logical_dim=grad.shape[0])
 
+    def _capped_psum_scatter(self, plan, grad):
+        """ZeRO reduce-scatter under the same byte cap as the AR buckets.
+
+        A whole-tensor ``psum_scatter`` of a huge gradient serializes
+        exactly like a mega all-reduce bucket would, so gradients above
+        the cap are split along a NON-scatter axis and reduce-scattered
+        chunk by chunk: ownership along the scatter axis is unchanged
+        (each chunk scatters the same row ranges to the same owners),
+        so concatenating the chunk results is elementwise-identical to
+        the single collective. 1-D gradients have no other axis to
+        split and go out whole (they are small in practice).
+        Returns the local shard value (pre-divided mean).
+        """
+        n = self.num_replicas
+        axis = plan.shard_axis
+        g = self._pad_grad(plan, grad)
+        cap = bucket_bytes_cap(plan.chunk_size)
+        nbytes = g.size * jnp.dtype(g.dtype).itemsize
+
+        def scatter(x):
+            return jax.lax.psum_scatter(
+                x, AXIS_DATA, scatter_dimension=axis, tiled=True) / n
+
+        if nbytes <= cap or g.ndim < 2:
+            self.last_bucket_stats.append({
+                'kind': 'psum_scatter', 'group': None,
+                'compressor': None, 'dtype': str(g.dtype),
+                'spec': plan.spec, 'vars': 1, 'bytes': int(nbytes),
+                'members': [plan.var.name]})
+            return scatter(g)
+        split_axis = 0 if axis != 0 else 1
+        dim = g.shape[split_axis]
+        k = min(dim, -(-int(nbytes) // cap))
+        bounds = [dim * i // k for i in range(1, k)]
+        parts = jnp.split(g, bounds, axis=split_axis)
+        for p in parts:
+            self.last_bucket_stats.append({
+                'kind': 'psum_scatter', 'group': None,
+                'compressor': None, 'dtype': str(g.dtype),
+                'spec': plan.spec, 'vars': 1,
+                'bytes': int(p.size * jnp.dtype(p.dtype).itemsize),
+                'members': [plan.var.name]})
+        return jnp.concatenate([scatter(p) for p in parts],
+                               axis=split_axis)
+
     def sync_gradients(self, sources, grads, env):
         """Average gradients across the data axis per each var's strategy.
 
-        Same-group AllReduce vars with a stateless compressor are fused
-        into a single flat concatenated collective (scoped-allocator
-        parity); stateful compressors (EF / PowerSGD) and PS vars are
-        reduced individually. Sparse-read (embedding) vars ship
-        (indices, rows) instead of the dense vocab-sized gradient whenever
-        that moves fewer bytes.
+        Same-group AllReduce vars with a stateless compressor are packed
+        into byte-capped buckets (``pack_buckets``; cap from the
+        strategy's ``chunk_size`` / ``AUTODIST_BUCKET_BYTES``) and one
+        collective is issued per bucket, in REVERSE gradient-production
+        order: the backward pass produces the LAST layer's gradients
+        first, so the tail bucket's collective launches while earlier
+        layers' backward compute is still in flight (with the XLA
+        latency-hiding scheduler, runtime/session.py) instead of one
+        model-sized concat serializing behind the whole backward and
+        doubling peak gradient memory. Stateful compressors (EF /
+        PowerSGD) and PS vars are reduced individually; sparse-read
+        (embedding) vars ship (indices, rows) instead of the dense
+        vocab-sized gradient whenever that moves fewer bytes; ZeRO
+        reduce-scatters are chunked under the same cap.
         """
+        self.last_bucket_stats = []
         if self.num_replicas == 1:
             return grads
         n = self.num_replicas
         out = list(grads)
-        fusable = {}   # (group, compressor cls, dtype) -> [idx]
+        fusable = {}   # (group, compressor cls, dtype, spec) -> [idx]
         for i, (var, grad) in enumerate(zip(sources, grads)):
             plan = self.plan_for(var)
             ids = self._sparse_ids(plan.var, env)
@@ -338,12 +442,9 @@ class ExecutionPlan:
                     continue
                 # ZeRO path: reduce-scatter straight to the shard owner;
                 # uneven partitions pad to the next multiple of the mesh.
-                g = self._pad_grad(plan, grad)
-                g = jax.lax.psum_scatter(
-                    g, AXIS_DATA, scatter_dimension=plan.shard_axis,
-                    tiled=True) / self.num_replicas
                 out[i] = ShardedGrad(
-                    g, plan.shard_axis,
+                    self._capped_psum_scatter(plan, grad),
+                    plan.shard_axis,
                     logical_dim=grad.shape[plan.shard_axis])
             elif (ids is not None and
                     type(plan.compressor) is comp.NoneCompressor and
@@ -359,25 +460,48 @@ class ExecutionPlan:
             else:
                 out[i] = plan.compressor.reduce(
                     grad, env, self._reduce_fn(plan.spec))
+        # Pack every fusable group into byte-capped buckets, then emit
+        # ALL buckets (across groups) ordered by reverse production:
+        # the bucket holding the highest variable indices first.
+        pending = []   # (bucket idx list, group, cname, dtype, spec)
         for (group, cname, dtype, spec), idxs in fusable.items():
-            if len(idxs) == 1:
-                i = idxs[0]
+            chunk = max(self.plan_for(sources[i]).chunk_size
+                        for i in idxs)
+            cap = bucket_bytes_cap(chunk)
+            items = [(i, int(grads[i].size *
+                             jnp.dtype(grads[i].dtype).itemsize))
+                     for i in reversed(idxs)]
+            for bucket in pack_buckets(items, cap,
+                                       chunk or DEFAULT_CHUNK_SIZE):
+                pending.append((bucket, group, cname, dtype, spec))
+        pending.sort(key=lambda b: -max(b[0]))
+        for bucket, group, cname, dtype, spec in pending:
+            nbytes = sum(int(grads[i].size *
+                             jnp.dtype(grads[i].dtype).itemsize)
+                         for i in bucket)
+            self.last_bucket_stats.append({
+                'kind': 'all_reduce', 'group': group,
+                'compressor': cname, 'dtype': dtype, 'spec': spec,
+                'vars': len(bucket), 'bytes': nbytes,
+                'members': [sources[i].name for i in bucket]})
+            if len(bucket) == 1:
+                i = bucket[0]
                 plan = self.plan_for(sources[i])
                 out[i] = plan.compressor.reduce(
                     grads[i], env, self._reduce_fn(spec))
                 continue
-            flats = [grads[i].reshape(-1) for i in idxs]
+            flats = [grads[i].reshape(-1) for i in bucket]
             sizes = [f.shape[0] for f in flats]
-            bucket = jnp.concatenate(flats)
+            buf = jnp.concatenate(flats)
             if cname == 'HorovodCompressor' and \
-                    bucket.dtype == jnp.float32:
-                bucket = self._reduce_fn(spec)(
-                    bucket.astype(jnp.bfloat16)).astype(jnp.float32)
+                    buf.dtype == jnp.float32:
+                buf = self._reduce_fn(spec)(
+                    buf.astype(jnp.bfloat16)).astype(jnp.float32)
             else:
-                bucket = self._reduce_fn(spec)(bucket)
+                buf = self._reduce_fn(spec)(buf)
             offset = 0
-            for i, size in zip(idxs, sizes):
-                out[i] = bucket[offset:offset + size].reshape(
+            for i, size in zip(bucket, sizes):
+                out[i] = buf[offset:offset + size].reshape(
                     grads[i].shape)
                 offset += size
         return out

@@ -391,16 +391,35 @@ class CoordClient:
         # Retry policy: while the version ADVANCES between attempts the
         # writer is alive and making progress (a multi-GB chunked push
         # legitimately holds the flag for seconds) — keep waiting, up
-        # to a generous cap.  The version only moves when a whole chunk
-        # frame lands, and one frame can take AUTODIST_PS_CHUNK_BYTES
-        # of wire time, so "stalled" is judged on a wall-clock window
-        # (STALL_TIMEOUT_S), not an attempt count: a version that stays
-        # odd AND unchanged that long is the dead-mid-push signature.
+        # to a configurable cap (AUTODIST_PS_TORN_RETRIES /
+        # AUTODIST_PS_TORN_BACKOFF_S).  The version only moves when a
+        # whole chunk frame lands, and one frame can take
+        # AUTODIST_PS_CHUNK_BYTES of wire time, so "stalled" is judged
+        # on a wall-clock window (STALL_TIMEOUT_S), not an attempt
+        # count: a version that stays odd AND unchanged that long is
+        # the dead-mid-push signature.
+        #
+        # Exhausting the cap is only an ERROR when parity is odd (a
+        # write is genuinely mid-chunk: returning would hand back a
+        # half-applied tensor). An even version that merely keeps
+        # MOVING between this pull's chunks means whole pushes keep
+        # landing — element-level staleness, the same benign mix any
+        # reader of a concurrently-updated accumulator sees — so the
+        # final assembly is returned with a warning instead of killing
+        # a healthy worker under frequent pushes. Caveat: each chunk of
+        # the assembly comes from a COMPLETE push, but different chunks
+        # may come from consecutive pushes — fine for commutative BADD
+        # accumulation and for fetch-side staleness, but a reader that
+        # needs one specific BSET snapshot must quiesce writers (the
+        # staleness gate) rather than rely on this path.
+        max_attempts = max(1, ENV.AUTODIST_PS_TORN_RETRIES.val)
+        backoff = ENV.AUTODIST_PS_TORN_BACKOFF_S.val
         last_ver = None
         last_progress = time.monotonic()
-        for attempt in range(100):
+        for attempt in range(max_attempts):
             parts = []
             first_ver = None
+            odd = False
             torn = False
             for off, count in ranges:
                 suffix = '' if len(ranges) == 1 and off == 0 and \
@@ -416,7 +435,7 @@ class CoordClient:
                     _decode(self._read_exact(int(fields[1])), wire))
                 ver = int(fields[2]) if len(fields) > 2 else None
                 if ver is not None and ver & 1:  # write in progress
-                    torn = True
+                    odd = torn = True
                 elif first_ver is None:
                     first_ver = ver
                 elif ver != first_ver:
@@ -425,24 +444,36 @@ class CoordClient:
                     if ver != last_ver:
                         last_ver = ver
                         last_progress = time.monotonic()
-                    break
-            if not torn:
+                    if not (attempt == max_attempts - 1 and not odd):
+                        break   # final even-skew pass reads to the end
+            if not torn or (attempt == max_attempts - 1 and not odd):
+                if torn:
+                    logging.warning(
+                        'BGET %s: version kept advancing for %d '
+                        'attempts (concurrent single-frame pushes); '
+                        'returning the last assembly — element-level '
+                        'staleness only, parity was even throughout '
+                        'the final pass', key, max_attempts)
                 arr = parts[0] if len(parts) == 1 else \
                     np.concatenate(parts)
                 if shape is not None:
                     arr = arr.reshape(shape)
                 return arr.astype(dtype, copy=False)
-            if time.monotonic() - last_progress > self.STALL_TIMEOUT_S:
+            if odd and time.monotonic() - last_progress > \
+                    self.STALL_TIMEOUT_S:
                 raise OSError(
                     'BGET %s: a chunked write is stuck mid-flight '
                     '(version parity odd and not advancing for %.0fs) '
                     '— a peer likely died mid-push'
                     % (key, self.STALL_TIMEOUT_S))
-            time.sleep(min(0.2, 0.01 * (attempt + 1)))
+            # linear backoff from the configured base, capped at the
+            # larger of 0.2s and one base interval (a base above 0.2
+            # must not be silently clamped back to the old cap)
+            time.sleep(min(max(0.2, backoff), backoff * (attempt + 1)))
         raise OSError(
-            'BGET %s: tensor kept changing under the pull (100 '
-            'attempts) — a writer is pushing continuously without the '
-            'staleness gate' % key)
+            'BGET %s: a chunked write was still mid-flight (version '
+            'parity odd) after %d attempts — raising rather than '
+            'returning a half-applied tensor' % (key, max_attempts))
 
     def vadd(self, key, delta, wire=None):
         """Atomically add a delta elementwise (apply-per-push, the

@@ -28,6 +28,11 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
                     ENV.AUTODIST_PS_CHUNK_BYTES,
                     ENV.AUTODIST_S2D_STEM, ENV.AUTODIST_DENSENET_DUS,
+                    # bucket layout + overlap flags must agree on every
+                    # traced host — divergent HLO across SPMD deadlocks
+                    ENV.AUTODIST_BUCKET_BYTES, ENV.AUTODIST_XLA_OVERLAP,
+                    ENV.AUTODIST_PS_TORN_RETRIES,
+                    ENV.AUTODIST_PS_TORN_BACKOFF_S,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 # AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
 # assignments ride the remote ssh command line, which is world-readable
@@ -46,6 +51,21 @@ class Coordinator:
         self.threads = []
         self.procs = []
         self._token_path = ''
+        # arm the XLA overlap flags BEFORE building worker envs: any
+        # AllReduce node means bucketed gradient sync, and the flags
+        # must reach workers at process start (their backend init)
+        from autodist_tpu.strategy.base import AllReduceSynchronizer
+        has_ar = any(
+            isinstance(s, AllReduceSynchronizer)
+            for node in strategy.node_config
+            for s in [node.synchronizer] + list(node.part_config)
+            if s is not None)
+        if has_ar:
+            from autodist_tpu.utils.jax_env import setup_overlap_flags
+            applied = setup_overlap_flags()
+            if applied:
+                logging.info('Armed XLA overlap flags for bucketed '
+                             'gradient sync: %s', applied)
 
     def _worker_env(self, worker_addr, process_id):
         env = {
@@ -68,6 +88,12 @@ class Coordinator:
             raw = os.environ.get(flag.name)
             if raw:
                 env[flag.name] = raw
+        # libtpu reads this once at backend init: forwarding it lets the
+        # overlap flags armed on the chief (utils/jax_env.py
+        # setup_overlap_flags) take effect from worker process start
+        raw = os.environ.get('LIBTPU_INIT_ARGS')
+        if raw:
+            env['LIBTPU_INIT_ARGS'] = raw
         if self._token_path:
             env[ENV.AUTODIST_COORD_TOKEN_FILE.name] = self._token_path
         return env

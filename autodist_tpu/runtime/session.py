@@ -29,10 +29,9 @@ from autodist_tpu.frontend import graph as fe
 from autodist_tpu.parallel.plan import ShardedGrad
 from autodist_tpu.utils import logging
 
-try:  # jax>=0.6 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# jax-version-portable shard_map (check_vma/check_rep spelling handled
+# by the shared compat helper)
+from autodist_tpu.parallel.axes import shard_map_compat as _shard_map
 
 
 class RunOptions:
@@ -130,6 +129,20 @@ class Session:
         if self._loose and coord is None:
             raise RuntimeError('loose multi-process mode needs a coord '
                                'service client')
+        # Bucketed AllReduce sync (plan.sync_gradients) only overlaps
+        # the backward pass if XLA is allowed to schedule the bucket
+        # collectives asynchronously — arm the latency-hiding flags
+        # (opt-out: AUTODIST_XLA_OVERLAP=0). libtpu reads them at
+        # backend init, so on an already-up backend they reach only
+        # processes launched after this point (the coordinator forwards
+        # LIBTPU_INIT_ARGS to workers).
+        if not self._loose and plan.num_replicas > 1 and \
+                any(p.is_ar for p in plan.var_plans.values()):
+            from autodist_tpu.utils.jax_env import setup_overlap_flags
+            applied = setup_overlap_flags()
+            if applied:
+                logging.info('Gradient bucketing active: armed XLA '
+                             'overlap flags %s', applied)
         # namespace coord-service keys by strategy id: a reused/leaked
         # service must not serve a previous run's vars or step counters
         self._ns = getattr(plan.strategy, 'id', 'default')
@@ -946,11 +959,10 @@ class Session:
             return outs, new_vars, new_opt, new_aux
 
         out_fetch_specs = [P(AXIS_DATA) for _ in fetch_nodes]
-        mapped = shard_map(
-            step, mesh=mesh,
-            in_specs=(var_specs, opt_specs, aux_specs, feed_specs),
-            out_specs=(out_fetch_specs, var_specs, opt_specs, aux_specs),
-            check_vma=False)
+        mapped = _shard_map(
+            step, mesh,
+            (var_specs, opt_specs, aux_specs, feed_specs),
+            (out_fetch_specs, var_specs, opt_specs, aux_specs))
         jitted = jax.jit(mapped, donate_argnums=(0, 1, 2))
         logging.debug('Compiled new step for %d fetches, %d feeds',
                       len(fetch_nodes), len(feed_nodes))

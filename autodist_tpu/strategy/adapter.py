@@ -179,6 +179,56 @@ def apply_strategy_to_shardings(strategy, graph_item, shardings, mesh):
         treedef, [out[n] for n in names])
 
 
+def grad_bucket_layout(strategy, graph_item):
+    """Byte-capped gradient-bucket layout for a strategy's AllReduce vars.
+
+    The same packing the execution plan applies at trace time
+    (``parallel.plan.pack_buckets``: same-(group, compressor, spec)
+    variables, reverse production order, cap from the synchronizer's
+    ``chunk_size`` / ``AUTODIST_BUCKET_BYTES``), computed statically
+    from the strategy + variable shapes so callers (bench reporting,
+    tooling) can audit the layout without tracing a step. Returns
+    ``[{'group', 'vars': [names], 'bytes'}]`` in emission order.
+    """
+    from autodist_tpu.const import DEFAULT_CHUNK_SIZE
+    from autodist_tpu.parallel.plan import bucket_bytes_cap, pack_buckets
+    from autodist_tpu.strategy.base import AllReduceSynchronizer
+
+    # mirror sync_gradients' fusable filter and grouping key exactly:
+    # only stateless compressors fuse (stateful ones reduce per-var),
+    # and the key includes the gradient dtype (mixed-dtype groups split)
+    groups = {}   # (group, compressor, spec, dtype) -> [(name, nb, ch)]
+    for node in strategy.node_config:
+        sync = node.synchronizer if not node.part_config \
+            else node.part_config[0]
+        if not isinstance(sync, AllReduceSynchronizer):
+            continue
+        if sync.compressor not in ('NoneCompressor',
+                                   'HorovodCompressor'):
+            continue
+        try:
+            var = graph_item.var_by_name(node.var_name)
+        except KeyError:
+            continue
+        nbytes = int(np.prod(var.shape or (1,))) * \
+            np.dtype(var.dtype).itemsize
+        groups.setdefault(
+            (sync.group, sync.compressor, sync.spec,
+             str(np.dtype(var.dtype))), []).append(
+            (node.var_name, nbytes, getattr(sync, 'chunk_size', 0)))
+    out = []
+    for (group, _, _, _), items in sorted(groups.items(), reverse=True):
+        chunk = max(c for _, _, c in items)
+        cap = bucket_bytes_cap(chunk)
+        rev = [(name, nbytes) for name, nbytes, _ in reversed(items)]
+        sizes = dict(rev)
+        for bucket in pack_buckets(rev, cap,
+                                   chunk or DEFAULT_CHUNK_SIZE):
+            out.append({'group': group, 'vars': list(bucket),
+                        'bytes': sum(sizes[n] for n in bucket)})
+    return out
+
+
 def trainer_from_strategy(model, optimizer, strategy_builder,
                           resource_spec=None, spec=None, **kw):
     """Build a Trainer whose state shardings follow a reference-style
@@ -198,4 +248,5 @@ def trainer_from_strategy(model, optimizer, strategy_builder,
     trainer.param_shardings = apply_strategy_to_shardings(
         strategy, gi, trainer.param_shardings, trainer.mesh)
     trainer.strategy = strategy
+    trainer.grad_buckets = grad_bucket_layout(strategy, gi)
     return trainer

@@ -59,10 +59,17 @@ class AllReduceSynchronizer:
     ``compressor`` names a gradient compressor class; ``group`` merges
     same-group variables into one fused collective (reference: scoped
     allocator; here: concatenated flat-bucket all-reduce).
+    ``chunk_size`` carries the builder's grouping bound so the execution
+    plan can derive its per-bucket byte cap (parallel/plan.py): fused
+    groups are further packed into byte-capped buckets so collectives
+    overlap the backward pass instead of serializing behind it. 0 means
+    "unspecified" (legacy strategies) and falls back to
+    const.DEFAULT_CHUNK_SIZE.
     """
     spec: str = 'AUTO'            # AUTO | RING
     compressor: str = 'NoneCompressor'
     group: int = 0
+    chunk_size: int = 0
     kind: str = 'AllReduce'
 
 

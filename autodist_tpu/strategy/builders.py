@@ -187,7 +187,8 @@ class AllReduce(StrategyBuilder):
                 synchronizer=AllReduceSynchronizer(
                     spec=self.all_reduce_spec,
                     compressor=self.compressor,
-                    group=i // self.chunk_size)))
+                    group=i // self.chunk_size,
+                    chunk_size=self.chunk_size)))
         return s
 
 
@@ -221,7 +222,8 @@ class PartitionedAR(StrategyBuilder):
         def ar(i):
             return AllReduceSynchronizer(
                 spec=self.all_reduce_spec, compressor=self.compressor,
-                group=(counter + i) // self.chunk_size)
+                group=(counter + i) // self.chunk_size,
+                chunk_size=self.chunk_size)
 
         if num_shards <= 1:
             return StrategyNode(var_name=var.name,
@@ -297,6 +299,7 @@ class Parallax(StrategyBuilder):
                     synchronizer=AllReduceSynchronizer(
                         spec=self.all_reduce_spec,
                         compressor=self.compressor,
-                        group=dense_count // self.chunk_size)))
+                        group=dense_count // self.chunk_size,
+                        chunk_size=self.chunk_size)))
                 dense_count += 1
         return s

@@ -25,5 +25,41 @@ def apply_jax_env_overrides():
     if m:
         try:
             jax.config.update('jax_num_cpu_devices', int(m.group(1)))
-        except RuntimeError:
+        except (RuntimeError, AttributeError):
+            # older jax spells this XLA_FLAGS only; the env var above
+            # already covers it when set before backend init
             pass
+
+
+# XLA flags that let bucketed gradient collectives actually overlap the
+# backward pass: the latency-hiding scheduler reorders independent
+# collectives ahead of compute, and async collective fusion turns each
+# bucket's all-reduce into a start/done pair compute can run between.
+# LIBTPU_INIT_ARGS is read once at libtpu initialization and ignored by
+# CPU/GPU backends, so setting it is safe on any host.
+OVERLAP_FLAGS = ('--xla_tpu_enable_latency_hiding_scheduler=true '
+                 '--xla_tpu_enable_async_collective_fusion=true')
+
+
+def setup_overlap_flags():
+    """Arm the XLA overlap flags for bucketed gradient synchronization.
+
+    Called at session setup when the execution plan has fused-AllReduce
+    (bucketed) variables; ``AUTODIST_XLA_OVERLAP=0`` opts out. The flags
+    are appended to ``LIBTPU_INIT_ARGS`` only if absent. libtpu reads
+    the variable once at backend init, so when the backend is already
+    up the setting reaches only processes launched after this point
+    (the coordinator forwards the variable to workers); returns the
+    flag string applied, or '' when opted out / already present.
+    """
+    from autodist_tpu.const import ENV
+    if not ENV.AUTODIST_XLA_OVERLAP.val:
+        return ''
+    cur = os.environ.get('LIBTPU_INIT_ARGS', '')
+    missing = [f for f in OVERLAP_FLAGS.split()
+               if f.split('=')[0] not in cur]
+    if not missing:
+        return ''
+    os.environ['LIBTPU_INIT_ARGS'] = \
+        (cur + ' ' + ' '.join(missing)).strip()
+    return ' '.join(missing)

@@ -72,11 +72,11 @@ def per_op_breakdown(trace_dir, line_name='XLA Ops'):
     ``top_ops`` ([(full op text, ns, count)] sorted by time). Empty
     when no trace/processor plane is found.
     """
-    from jax.profiler import ProfileData
     files = sorted(glob.glob(os.path.join(trace_dir, '**', '*.xplane.pb'),
                              recursive=True), key=os.path.getmtime)
     if not files:
         return {}
+    from jax.profiler import ProfileData
     pd = ProfileData.from_file(files[-1])
     # the busiest device plane's per-op line (real hardware traces);
     # CPU-backend traces carry only host execution lines, so fall back
@@ -115,6 +115,60 @@ def per_op_breakdown(trace_dir, line_name='XLA Ops'):
             'by_category': dict(sorted(by_cat.items(),
                                        key=lambda kv: -kv[1])),
             'top_ops': top}
+
+
+def bucket_report(plan, trace_dir=None):
+    """Per-bucket accounting for a bucketed-sync execution plan.
+
+    ``plan.last_bucket_stats`` (recorded at trace time by
+    ``ExecutionPlan.sync_gradients``) gives the byte layout: one entry
+    per emitted collective with its kind, group, dtype and byte count.
+    With ``trace_dir`` (a captured profile), each collective category's
+    measured device time is attached, so the overlap the bucketing
+    exists for is auditable: total collective ns vs total step ns, and
+    the per-bucket wire bytes feeding it.
+
+    Returns ``{'buckets': [...], 'num_buckets', 'total_bytes',
+    'max_bucket_bytes', 'collective_ns', 'total_ns'}`` (the *_ns fields
+    only when a trace is given and parseable).
+    """
+    stats = list(getattr(plan, 'last_bucket_stats', []) or [])
+    out = {
+        'buckets': stats,
+        'num_buckets': len(stats),
+        'total_bytes': sum(b.get('bytes', 0) for b in stats),
+        'max_bucket_bytes': max([b.get('bytes', 0) for b in stats],
+                                default=0),
+    }
+    if trace_dir:
+        rep = per_op_breakdown(trace_dir)
+        if rep:
+            out['collective_ns'] = rep['by_category'].get('collective', 0)
+            out['total_ns'] = rep['total_ns']
+    return out
+
+
+def collective_timeline(trace_dir, line_name='XLA Ops'):
+    """Per-collective-op durations from a captured trace.
+
+    Filters :func:`per_op_breakdown`'s top_ops down to collective-
+    category ops (all-reduce / reduce-scatter / all-gather /
+    collective-permute / all-to-all, sync or ``-start``/``-done``
+    halves): one row per distinct op — with bucketed gradient sync that
+    is one row per bucket — as ``[(op text, ns, count)]`` sorted by
+    time. The per-bucket latency view of the overlap scheduler.
+    """
+    rep = per_op_breakdown(trace_dir, line_name=line_name)
+    if not rep:
+        return []
+    rows = []
+    for name, ns, cnt in rep['top_ops']:
+        base = _op_head(name).strip().lstrip('%')
+        if re.match(r'(all-reduce|all-gather|reduce-scatter|'
+                    r'collective-permute|all-to-all)(-start|-done)?',
+                    re.sub(r'[.\d]+$', '', base)):
+            rows.append((name, ns, cnt))
+    return rows
 
 
 def format_breakdown(report, top_n=10, name_width=100):

@@ -6,16 +6,26 @@ CPU mesh replaces that dance (SURVEY.md §4 implication note).
 
 Note: this image's sitecustomize registers a TPU ("axon") PJRT plugin in
 every interpreter and pins JAX_PLATFORMS, so plain env vars are ignored —
-``jax.config.update`` after import is the reliable override.
+``jax.config.update`` after import is the reliable override. Older jax
+(<0.4.38) has no ``jax_num_cpu_devices`` option; there the XLA_FLAGS env
+var (set below BEFORE the first backend init) carries the device count.
 """
 import os
 
 os.environ.setdefault('AUTODIST_IS_TESTING', 'True')
+if 'xla_force_host_platform_device_count' not in \
+        os.environ.get('XLA_FLAGS', ''):
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '') +
+        ' --xla_force_host_platform_device_count=8').strip()
 
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 8)
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:   # older jax: XLA_FLAGS above already covers it
+    pass
 
 import pytest  # noqa: E402
 

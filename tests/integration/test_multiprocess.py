@@ -52,10 +52,19 @@ def _shutdown_service(addr):
 
 COMMON_PRELUDE = textwrap.dedent("""
     import json, os, sys, time
+    # conftest's inherited XLA_FLAGS would give this worker 8 virtual
+    # devices on jax without jax_num_cpu_devices; strip it BEFORE the
+    # backend initializes so every worker runs the intended 1 device
+    os.environ['XLA_FLAGS'] = ' '.join(
+        f for f in os.environ.get('XLA_FLAGS', '').split()
+        if 'xla_force_host_platform_device_count' not in f)
     import numpy as np
     import jax
     jax.config.update('jax_platforms', 'cpu')
-    jax.config.update('jax_num_cpu_devices', 1)
+    try:
+        jax.config.update('jax_num_cpu_devices', 1)
+    except AttributeError:   # older jax: single CPU device is the default
+        pass
     sys.path.insert(0, %(repo)r)
     import autodist_tpu as ad
 
@@ -421,6 +430,7 @@ def test_partitioned_var_shards_span_endpoints(tmp_path):
 
 
 @pytest.mark.integration
+@pytest.mark.slow
 def test_loose_mode_carries_100mb_model_multi_endpoint(tmp_path):
     """The binary PS data plane carries a real (≥100 MB) model, spread
     over TWO PS endpoints placed by PSLoadBalancing's byte-size
@@ -674,6 +684,7 @@ def test_four_worker_loose_staleness_and_heartbeats(tmp_path):
 
 
 @pytest.mark.integration
+@pytest.mark.slow
 def test_four_worker_loose_100mb_two_endpoints(tmp_path):
     """The PS data plane at FOUR concurrent workers x 105 MB model x 2
     endpoints: every worker's pulls and pushes land and the aggregate

@@ -27,6 +27,45 @@ def test_bench_cpu_smoke_emits_one_json_line():
     for field in ('metric', 'value', 'unit', 'vs_baseline'):
         assert field in rec, rec
     assert rec['value'] > 0
+    # the JSON carries the fields the perf trajectory needs (ISSUE 1):
+    # platform, bucket count and per-step sync time
+    extra = rec['extra']
+    assert extra['platform'] == 'cpu'
+    gs = extra['grad_sync']
+    assert gs['bucket_count'] >= 1
+    assert gs['per_step_sync_time_s'] > 0
+    assert gs['sync_bytes'] > 0
+
+
+def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):
+    """The recorded BENCH_r0* failure mode: the TPU/axon plugin raises
+    UNAVAILABLE at init. resolve_devices must fall back to the CPU
+    backend instead of crashing."""
+    monkeypatch.setenv('JAX_PLATFORMS',
+                       os.environ.get('JAX_PLATFORMS', 'cpu'))
+    monkeypatch.setenv('XLA_FLAGS', os.environ.get('XLA_FLAGS', ''))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'bench_mod_fb', os.path.join(REPO, 'bench.py'))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    import jax
+    calls = {'n': 0}
+    real_devices = jax.devices
+
+    def flaky_devices(*a, **kw):
+        calls['n'] += 1
+        if calls['n'] == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+                "backend setup/compile error (Unavailable).")
+        return real_devices(*a, **kw)
+
+    monkeypatch.setattr(jax, 'devices', flaky_devices)
+    devs, fell_back = m.resolve_devices()
+    assert fell_back
+    assert devs and devs[0].platform == 'cpu'
+    assert os.environ.get('JAX_PLATFORMS') == 'cpu'
 
 
 def test_bench_scaling_mode_reports_efficiency():

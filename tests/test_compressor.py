@@ -24,8 +24,9 @@ def test_int8_ring_matches_psum():
     def ring(x):
         return int8_ring_all_reduce(x, 'data')
 
-    got = jax.jit(jax.shard_map(ring, mesh=mesh, in_specs=P('data'),
-                                out_specs=P('data')))(x)
+    from autodist_tpu.parallel.axes import shard_map_compat
+    got = jax.jit(shard_map_compat(ring, mesh, P('data'),
+                                   P('data')))(x)
     want = x.sum(axis=0, keepdims=True).repeat(8, 0)
     # three quantization stages, each ~|max|/127 -> few-percent tolerance
     tol = 0.05 * float(jnp.max(jnp.abs(want)))

@@ -119,6 +119,23 @@ def test_densenet_dus_block_form_is_exact(monkeypatch):
                                    atol=2e-5)
 
 
+def test_densenet_dus_heterogeneous_growth_raises(monkeypatch):
+    """The DUS buffer is sized from the FIRST layer's growth; a
+    heterogeneous-growth block must error instead of silently clamping
+    later layers' writes (ISSUE 1 satellite)."""
+    from autodist_tpu.models import vision
+    model = vision.DenseNet((2, 2), num_classes=4)
+    # the guard fires at trace time, so eval_shape (no compile) covers it
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32)
+    # simulate a heterogeneous block: second dense layer grows wider
+    model.layers[1][1].conv2.out_ch = \
+        model.layers[1][1].conv2.out_ch + 8
+    monkeypatch.setenv('AUTODIST_DENSENET_DUS', '1')
+    with pytest.raises(ValueError, match='conv2.out_ch'):
+        jax.eval_shape(model.apply, params, x)
+
+
 def test_vgg_wrong_spatial_raises():
     from autodist_tpu.models import vision
     model = vision.VGG((8, 'M'), num_classes=5)   # fc sized for 7x7

@@ -276,6 +276,84 @@ def test_torn_read_detection(coord, monkeypatch):
     assert int(fields[2]) % 2 == 0  # sequence aborted, reads flow
 
 
+def test_malformed_offset0_frame_does_not_close_others_sequence(coord):
+    """ISSUE 1 satellite: a REJECTED offset-0 frame never opened a
+    sequence (SeqFrame is constructed after the payload/range checks),
+    so it must NOT decrement open_writes — that would close another
+    writer's in-flight chunked sequence and clear the torn-read parity
+    bit under its feet."""
+    c = coord()
+    w = coord()
+    evil = coord()
+    t = np.arange(10, dtype=np.float32)
+    c.vset('own', t)
+    half = t[:5].tobytes()
+    # w opens a 2-chunk sequence and stalls mid-flight
+    assert w._rpc('BSET own %d f32 0 10' % len(half), half) == 'OK'
+
+    def parity():
+        resp = c._rpc('BGET own f32 v')
+        fields = resp.split()
+        c._read_exact(int(fields[1]))
+        return int(fields[2]) % 2
+
+    assert parity() == 1
+    # another writer's malformed OFFSET-0 frames must not close it:
+    # bad payload (3 bytes is not a whole f32)...
+    assert evil._rpc('BADD own 3 f32', b'abc').startswith(
+        'ERR bad payload')
+    assert parity() == 1
+    # ...and a bad range (negative offset)
+    assert evil._rpc('BSET own %d f32 -1 10' % len(half), half) \
+        .startswith('ERR bad range')
+    assert parity() == 1
+    # w completes; reads flow with the full value intact
+    assert w._rpc('BSET own %d f32 5 10' % len(half),
+                  t[5:].tobytes()) == 'OK'
+    np.testing.assert_array_equal(c.vget('own', shape=(10,)), t)
+    # a malformed CONTINUATION chunk (off>0) still aborts the open
+    # sequence — that is the anti-wedge guard this satellite preserves
+    assert w._rpc('BSET own %d f32 0 10' % len(half), half) == 'OK'
+    assert parity() == 1
+    assert evil._rpc('BADD own 3 f32 5 10', b'abc').startswith(
+        'ERR bad payload')
+    assert parity() == 0
+
+
+def test_vget_even_parity_exhaustion_returns(coord, monkeypatch):
+    """ISSUE 1 satellite: element-level staleness under frequent
+    single-frame pushes is benign — when the version keeps ADVANCING
+    with even parity past the (configurable) retry cap, vget returns
+    the last assembly instead of killing a healthy worker; it raises
+    only when parity is odd (genuinely mid-chunk)."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setenv('AUTODIST_PS_TORN_RETRIES', '3')
+    monkeypatch.setenv('AUTODIST_PS_CHUNK_BYTES', '20')  # 5 f32/chunk
+    c = coord()
+    pusher = coord()
+    t = np.arange(10, dtype=np.float32)
+    c.vset('skew', t)
+    real_rpc = CoordClient._rpc
+
+    def rpc_with_push(self, line, payload=None):
+        # a whole single-frame push lands before every BGET chunk, so
+        # the version advances (even parity) between this pull's chunks
+        # on every attempt
+        if self is c and line.startswith('BGET skew'):
+            real_rpc(pusher, 'BADD skew 40 f32',
+                     np.ones(10, np.float32).tobytes())
+        return real_rpc(self, line, payload)
+
+    monkeypatch.setattr(CoordClient, '_rpc', rpc_with_push)
+    got = c.vget('skew', shape=(10,))   # must NOT raise
+    assert got.shape == (10,)
+    # rows are base + k pushes; chunks may straddle one push boundary
+    base = np.arange(10, dtype=np.float32)
+    k = got - base
+    assert (k >= 1).all() and (k <= 16).all()
+    assert np.ptp(k) <= 1   # at most one push of skew across chunks
+
+
 def test_oversized_payload_declaration_refused(coord):
     """A header declaring an absurd payload size is refused immediately
     (ERR + close) instead of buffering toward it (ADVICE r3)."""

@@ -2,6 +2,7 @@
 over RunOptions/jax.profiler traces that produced the round-3/4
 performance diagnoses, shipped as a framework utility."""
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +10,17 @@ import jax.numpy as jnp
 from autodist_tpu.utils.profiling import format_breakdown, per_op_breakdown
 
 
+def _has_profile_data():
+    try:
+        from jax.profiler import ProfileData  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(not _has_profile_data(),
+                    reason='jax.profiler.ProfileData unavailable '
+                           '(older jax)')
 def test_breakdown_from_real_trace(tmp_path):
     @jax.jit
     def step(a, b):
