@@ -116,6 +116,134 @@ def pack_buckets(items, cap_bytes, max_vars=0):
     return buckets
 
 
+def static_collective_schedule(strategy, graph_item, num_replicas,
+                               sparse_lookups_per_replica=4096):
+    """Static mirror of :meth:`ExecutionPlan.sync_gradients`'s emission.
+
+    Computes, WITHOUT tracing a step, the per-step collective schedule a
+    strategy lowers to on an ``num_replicas``-way data mesh: the same
+    bucket packing (``pack_buckets`` under the chunk_size-derived byte
+    cap, reverse production order), the same ZeRO ``psum_scatter``
+    chunking, and the param re-gather each sharded variable pays on the
+    next step. This is what the simulator's cost model prices.
+
+    Entries match the ``last_bucket_stats`` schema plus a ``phase``
+    field: ``{'kind', 'group', 'compressor', 'dtype', 'spec', 'vars',
+    'bytes', 'members', 'phase'}`` where ``phase`` is ``'grad'``
+    (gradient sync) or ``'param'`` (ZeRO param all-gather). ``bytes``
+    are RAW tensor bytes (the wire may be smaller under a compressor —
+    the cost model applies the wire factor). Sparse (embedding) vars
+    assume ``sparse_lookups_per_replica`` looked-up rows per step, the
+    runtime's data-dependent quantity.
+    """
+    import numpy as np
+
+    n = int(num_replicas)
+    entries = []
+    if n <= 1:
+        return entries
+    nodes = {nd.var_name: nd for nd in strategy.node_config}
+    sources = list(graph_item.trainable_var_op_to_var.values())
+    plans = []
+    for var in sources:
+        node = nodes.get(var.name)
+        if node is None:
+            from autodist_tpu.strategy.base import StrategyNode
+            node = StrategyNode(var_name=var.name,
+                                synchronizer=AllReduceSynchronizer())
+        plan = VarPlan(var, node)
+        # mirror ExecutionPlan.__init__'s state-sharding rule
+        if plan.is_ps and len(var.shape) > 0:
+            ax = plan.shard_axis
+            if var.shape[ax] >= n and plan.num_shards > 1:
+                plan.state_sharded = True
+                dim = int(var.shape[ax])
+                plan.padded_dim = -(-dim // n) * n
+                plan.pad = plan.padded_dim - dim
+        plans.append(plan)
+
+    def entry(kind, plan, nbytes, members, phase='grad', vars_=1,
+              group=None, compressor=None):
+        return {'kind': kind, 'group': group, 'compressor': compressor,
+                'dtype': str(np.dtype(plan.var.dtype)), 'spec': plan.spec,
+                'vars': vars_, 'bytes': int(nbytes), 'members': members,
+                'phase': phase}
+
+    fusable = {}   # (group, compressor cls name, dtype, spec) -> [idx]
+    for i, (var, plan) in enumerate(zip(sources, plans)):
+        itemsize = np.dtype(var.dtype).itemsize
+        size = int(np.prod(var.shape or (1,)))
+        nbytes = size * itemsize
+        sparse = bool(graph_item.is_sparse(var)) and len(var.shape) == 2
+        b = min(sparse_lookups_per_replica, int(var.shape[0])) \
+            if sparse else 0
+        sparse_bytes = n * b * (int(var.shape[1]) + 1) * itemsize \
+            if sparse else None
+        cname = type(plan.compressor).__name__
+        if plan.state_sharded:
+            padded_shape = list(var.shape)
+            padded_shape[plan.shard_axis] = plan.padded_dim or \
+                var.shape[plan.shard_axis]
+            padded = int(np.prod(padded_shape)) * itemsize
+            if sparse and plan.shard_axis == 0 and \
+                    sparse_bytes < nbytes // n:
+                entries.append(entry('sparse_scatter', plan, sparse_bytes,
+                                     [var.name]))
+            else:
+                # mirror _capped_psum_scatter's chunking exactly
+                cap = bucket_bytes_cap(plan.chunk_size)
+                ndim = len(var.shape)
+                if padded <= cap or ndim < 2:
+                    entries.append(entry('psum_scatter', plan, padded,
+                                         [var.name]))
+                else:
+                    split_axis = 0 if plan.shard_axis != 0 else 1
+                    dim = int(padded_shape[split_axis])
+                    row = padded // dim
+                    k = min(dim, -(-padded // cap))
+                    for j in range(k):
+                        rows = dim * (j + 1) // k - dim * j // k
+                        entries.append(entry('psum_scatter', plan,
+                                             rows * row, [var.name]))
+            # the updated shard is re-gathered to full for the next step
+            entries.append(entry('all_gather', plan, padded,
+                                 [var.name], phase='param'))
+        elif sparse and type(plan.compressor) is comp.NoneCompressor \
+                and sparse_bytes < nbytes:
+            entries.append(entry('sparse_all_gather', plan, sparse_bytes,
+                                 [var.name]))
+        elif plan.is_ar and plan.group is not None and \
+                type(plan.compressor) in (comp.NoneCompressor,
+                                          comp.HorovodCompressor):
+            key = (plan.group, cname, str(np.dtype(var.dtype)), plan.spec)
+            fusable.setdefault(key, []).append(i)
+        else:
+            entries.append(entry('all_reduce', plan, nbytes, [var.name],
+                                 group=plan.group, compressor=cname))
+    # pack fusable groups exactly like sync_gradients: byte-capped
+    # buckets in reverse production order, emitted tail-first
+    pending = []
+    for (group, cname, dtype, spec), idxs in fusable.items():
+        chunk = max(plans[i].chunk_size for i in idxs)
+        cap = bucket_bytes_cap(chunk)
+        items = [(i, int(np.prod(sources[i].shape or (1,))) *
+                  np.dtype(sources[i].dtype).itemsize)
+                 for i in reversed(idxs)]
+        sizes = dict(items)
+        for bucket in pack_buckets(items, cap,
+                                   chunk or DEFAULT_CHUNK_SIZE):
+            pending.append((bucket, sizes, group, cname, dtype, spec))
+    pending.sort(key=lambda b: -max(b[0]))
+    for bucket, sizes, group, cname, dtype, spec in pending:
+        entries.append({
+            'kind': 'all_reduce', 'group': group, 'compressor': cname,
+            'dtype': dtype, 'spec': spec, 'vars': len(bucket),
+            'bytes': sum(sizes[i] for i in bucket),
+            'members': [sources[i].name for i in bucket],
+            'phase': 'grad'})
+    return entries
+
+
 class ShardedGrad:
     """A reduce-scattered gradient shard (ZeRO-sharded PS variables).
 

@@ -26,6 +26,123 @@ class DeviceType(Enum):
     TPU = 2
 
 
+#: Device kinds a ``topology.device_kind`` hint may name. Matching is by
+#: substring, like bench.py's peak-FLOPs table ('v5e' matches 'tpu v5e').
+#: First match wins, so the more specific v5p/v5e come before v5.
+KNOWN_DEVICE_KINDS = ('v6', 'v5p', 'v5e', 'v5', 'v4', 'v3', 'v2',
+                      'gpu', 'cpu')
+
+#: Per-device-KIND ICI defaults (bandwidth GB/s, latency us): coarse
+#: per-device effective ring bandwidth from public figures, refining
+#: the per-TYPE default below when ``topology.device_kind`` names a
+#: generation but no explicit bandwidth is given.
+_ICI_BY_KIND = {
+    'v6': (220.0, 1.0),
+    'v5p': (180.0, 1.0),
+    'v5e': (80.0, 1.0),
+    'v5': (80.0, 1.0),
+    'v4': (100.0, 1.0),
+    'v3': (70.0, 1.0),
+    'v2': (50.0, 1.0),
+    'gpu': (60.0, 3.0),
+    'cpu': (10.0, 5.0),
+}
+
+#: Per-device-type link defaults (bandwidth GB/s, latency us) used when a
+#: spec carries no explicit ``topology:`` hints. ICI numbers are
+#: per-device effective ring bandwidth (conservative public figures);
+#: the CPU "ici" is host-memory traffic between virtual devices.
+_ICI_DEFAULTS = {
+    DeviceType.TPU: (100.0, 1.0),
+    DeviceType.GPU: (60.0, 3.0),
+    DeviceType.CPU: (10.0, 5.0),
+}
+_DCN_DEFAULT_LATENCY_US = 30.0
+
+
+class Topology:
+    """Validated ICI/DCN link model for the strategy simulator.
+
+    Built from a spec's optional top-level ``topology:`` block::
+
+        topology:
+          ici_bandwidth_gbps: 100   # GB/s per device, intra-slice
+          ici_latency_us: 1
+          dcn_bandwidth_gbps: 12.5  # GB/s per device, cross-slice/node
+          dcn_latency_us: 30
+          device_kind: v5e          # optional, one of KNOWN_DEVICE_KINDS
+
+    Missing fields default from the spec's device types (ICI) and the
+    per-node ``network_bandwidth`` (DCN: GBE is gigaBITs, so /8).
+    All fields are validated at parse time — the simulator consumes
+    them blindly.
+    """
+
+    _NUMERIC_FIELDS = ('ici_bandwidth_gbps', 'ici_latency_us',
+                       'dcn_bandwidth_gbps', 'dcn_latency_us')
+
+    def __init__(self, info, accel_type, min_net_bandwidth_gbe,
+                 multi_node):
+        info = dict(info or {})
+        for field in self._NUMERIC_FIELDS:
+            val = info.get(field)
+            if val is None:
+                continue
+            if not isinstance(val, (int, float)) or \
+                    isinstance(val, bool) or val <= 0:
+                raise ValueError(
+                    'topology.%s must be a positive number, got %r'
+                    % (field, val))
+        kind = info.get('device_kind')
+        matched_kind = None
+        if kind is not None:
+            k = str(kind).lower()
+            matched_kind = next((known for known in KNOWN_DEVICE_KINDS
+                                 if known in k), None)
+            if matched_kind is None:
+                raise ValueError(
+                    'topology.device_kind %r is not a known device type '
+                    '(known: %s)' % (kind, ', '.join(KNOWN_DEVICE_KINDS)))
+        unknown = set(info) - set(self._NUMERIC_FIELDS) - {'device_kind'}
+        if unknown:
+            raise ValueError(
+                'Unknown topology field(s) %s (known: %s, device_kind)'
+                % (sorted(unknown), ', '.join(self._NUMERIC_FIELDS)))
+        # device_kind refines the ICI defaults by TPU generation
+        if matched_kind is not None:
+            ici_bw, ici_lat = _ICI_BY_KIND[matched_kind]
+        else:
+            ici_bw, ici_lat = _ICI_DEFAULTS[accel_type]
+        self.device_kind = str(kind).lower() if kind is not None else ''
+        self.ici_bandwidth_gbps = float(
+            info.get('ici_bandwidth_gbps', ici_bw))
+        self.ici_latency_us = float(info.get('ici_latency_us', ici_lat))
+        self.dcn_bandwidth_gbps = float(
+            info.get('dcn_bandwidth_gbps',
+                     max(min_net_bandwidth_gbe, 0.001) / 8.0))
+        self.dcn_latency_us = float(
+            info.get('dcn_latency_us', _DCN_DEFAULT_LATENCY_US))
+        self.multi_node = bool(multi_node)
+
+    def link(self, cross_node=False):
+        """(bytes/s, seconds) for one link class.
+
+        ``cross_node=True`` prices the DCN (cross-slice / cross-host)
+        path; else the intra-slice ICI path.
+        """
+        if cross_node:
+            return (self.dcn_bandwidth_gbps * 1e9,
+                    self.dcn_latency_us * 1e-6)
+        return (self.ici_bandwidth_gbps * 1e9,
+                self.ici_latency_us * 1e-6)
+
+    def __repr__(self):
+        return ('<Topology ici=%.1fGB/s,%.1fus dcn=%.2fGB/s,%.1fus%s>'
+                % (self.ici_bandwidth_gbps, self.ici_latency_us,
+                   self.dcn_bandwidth_gbps, self.dcn_latency_us,
+                   ' multi-node' if self.multi_node else ''))
+
+
 class DeviceSpec:
     """One addressable device: ``<host>:<TYPE>:<index>``."""
 
@@ -100,6 +217,8 @@ class ResourceSpec:
         self.__network_bandwidth = {}
         self.mesh_hint = {}
         self.coordinator_address = None
+        self.__topology = None
+        self.__topology_info = {}
 
         if resource_file is not None:
             if not os.path.isfile(resource_file):
@@ -152,6 +271,11 @@ class ResourceSpec:
                     'Network bandwidth missing for node %s; defaulting to '
                     '%d GBE', address, DEFAULT_NETWORK_BANDWIDTH)
                 bw = DEFAULT_NETWORK_BANDWIDTH
+            elif not isinstance(bw, (int, float)) or \
+                    isinstance(bw, bool) or bw <= 0:
+                raise ValueError(
+                    'nodes[%s].network_bandwidth must be a positive '
+                    'number, got %r' % (address, bw))
             self.__network_bandwidth[address] = bw
 
         if len(self.__nodes) == 1:
@@ -159,6 +283,21 @@ class ResourceSpec:
         if self.__chief_address is None:
             raise ValueError('Must specify one chief node in a '
                              'multi-node spec')
+        # topology hints are validated eagerly (parse time), not at
+        # first .topology access: the simulator consumes them blindly
+        self.__topology_info = dict(info.get('topology', {}) or {})
+        self.__topology = Topology(
+            self.__topology_info, self._accel_type(),
+            min(self.__network_bandwidth.values()),
+            multi_node=len(self.__nodes) > 1)
+
+    def _accel_type(self):
+        """Dominant accelerator DeviceType (for topology defaults)."""
+        types = {d.device_type for _, d in self.__devices.items()}
+        for t in (DeviceType.TPU, DeviceType.GPU):
+            if t in types:
+                return t
+        return DeviceType.CPU
 
     @staticmethod
     def _discover_local_tpus():
@@ -220,6 +359,15 @@ class ResourceSpec:
     def network_bandwidth(self):
         """Per-node bandwidth map (GBE)."""
         return dict(self.__network_bandwidth)
+
+    @property
+    def topology(self):
+        """Validated :class:`Topology` (ICI/DCN bandwidth+latency hints).
+
+        Always present: explicit ``topology:`` fields override, the rest
+        defaults from the spec's device types and node bandwidths.
+        """
+        return self.__topology
 
     @property
     def ssh_config_map(self):

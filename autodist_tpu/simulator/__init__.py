@@ -1,0 +1,32 @@
+"""Strategy simulator: analytic cost model + candidate search + calibration.
+
+The reference paper's value proposition is *automatic* strategy
+synthesis; upstream AutoDist ships a ``simulator/`` package that prices
+candidate strategies before running any of them. This package is the
+TPU-native equivalent:
+
+- :mod:`cost_model` — α-β collective pricing per variable (ring
+  AllReduce, ZeRO reduce-scatter+all-gather, partitioned AR) from tensor
+  bytes, compressor wire dtype, the bucket layout the execution plan
+  would emit (``parallel.plan.static_collective_schedule``), and the
+  ICI/DCN bandwidth+latency hints in :class:`ResourceSpec`'s topology;
+  plus a per-device memory footprint estimate (params, grads, optimizer
+  state, bucket staging).
+- :mod:`search` — candidate enumeration over the strategy builders (and
+  their chunk_size / partition knobs) with memory-budget pruning,
+  returning ranked ``(Strategy, predicted_step_time, peak_bytes)``.
+- :mod:`calibrate` — optional measured mode refining the α-β constants
+  from a ``profiling.collective_timeline`` of a short real run.
+
+The user-facing entry points are ``strategy.builders.AutoStrategy`` (the
+tenth builder — calls the simulator inside ``build()``) and
+``tools/simulate.py`` (prints the ranked table without running anything).
+"""
+from autodist_tpu.simulator.cost_model import (  # noqa: F401
+    CostModelParams, CostReport, collective_time, memory_footprint,
+    predict, wire_bytes)
+from autodist_tpu.simulator.search import (  # noqa: F401
+    Candidate, default_candidates, rank)
+from autodist_tpu.simulator.calibrate import (  # noqa: F401
+    calibrate_from_timeline, calibrate_from_trace, fit_alpha_beta,
+    samples_from_timeline)

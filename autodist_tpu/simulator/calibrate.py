@@ -1,0 +1,156 @@
+"""Measured mode: refine α-β constants from a short real run.
+
+The analytic defaults in :class:`CostModelParams` come from topology
+hints; a 3-step profiled run gives ground truth. The feed is
+:func:`autodist_tpu.utils.profiling.collective_timeline` — one row per
+distinct collective op (with bucketed sync, one per bucket) as
+``(op text, total ns, count)``. The op text is the full HLO
+instruction, so the RESULT shapes (between ``' = '`` and the op name)
+give the wire bytes; a least-squares fit of per-occurrence time against
+the KIND-AWARE cost shape (ring all-reduce ``2(n-1)α + 2(n-1)/n·B·β``,
+reduce-scatter/all-gather ``(n-1)α + (n-1)/n·B·β``, permute ``α + B·β``)
+yields α and β for the link class. Async ``-start`` halves are dropped
+(operand-echoing result tuples, launch-only durations).
+
+Degrades gracefully: no trace, no collective rows, or a degenerate fit
+(all samples the same size) leaves the analytic constants in place with
+a logged warning — CPU-fallback runs calibrate nothing and lose nothing.
+"""
+import re
+
+from autodist_tpu.utils import logging
+
+_DTYPE_BYTES = {'pred': 1, 's8': 1, 'u8': 1, 's16': 2, 'u16': 2,
+                'bf16': 2, 'f16': 2, 's32': 4, 'u32': 4, 'f32': 4,
+                's64': 8, 'u64': 8, 'f64': 8}
+_SHAPE_RE = re.compile(r'(\w+)\[([\d,]*)\]')
+_KIND_RE = re.compile(
+    r'(all-reduce|all-gather|reduce-scatter|collective-permute|'
+    r'all-to-all)(-start|-done)?\(')
+
+
+def _result_bytes_and_kind(op_text):
+    """(wire bytes, collective kind) of one HLO instruction, or None.
+
+    Result shapes only — operands sit after the op name. ``-start``
+    halves of async pairs are DROPPED: their result tuples include the
+    input operand buffer (double-counted bytes) and their duration is
+    the launch, not the transfer; the ``-done`` half carries the
+    completion wait at the true output shape.
+    """
+    m = _KIND_RE.search(op_text)
+    eq = op_text.find(' = ')
+    if not m or eq < 0 or m.start() < eq:
+        return None
+    if m.group(2) == '-start':
+        return None
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(op_text[eq + 3:m.start()]):
+        size = _DTYPE_BYTES.get(dtype, 4)
+        for d in filter(None, dims.split(',')):
+            size *= int(d)
+        total += size
+    if not total:
+        return None
+    return total, m.group(1)
+
+
+def samples_from_timeline(timeline):
+    """``[(wire_bytes, kind, seconds_per_occurrence)]`` from timeline
+    rows (``-start`` async halves dropped — see
+    :func:`_result_bytes_and_kind`)."""
+    samples = []
+    for name, ns, cnt in timeline:
+        bk = _result_bytes_and_kind(name)
+        if bk is None or not cnt or ns <= 0:
+            continue
+        samples.append((bk[0], bk[1], ns / 1e9 / cnt))
+    return samples
+
+
+#: (hop multiplier, byte multiplier as a fraction of (n-1)/n·B) per
+#: collective kind — the kind-specific cost shapes the fit inverts.
+#: all-reduce is the ring (two phases); RS/AG are one phase each;
+#: a permute is one hop moving the full buffer once.
+def _kind_factors(kind, n):
+    if kind == 'all-reduce':
+        return 2.0 * (n - 1), 2.0 * (n - 1) / n
+    if kind in ('reduce-scatter', 'all-gather', 'all-to-all'):
+        return float(n - 1), float(n - 1) / n
+    if kind == 'collective-permute':
+        return 1.0, 1.0
+    return None
+
+
+def fit_alpha_beta(samples, num_replicas):
+    """Least-squares (α, β) over kind-aware cost shapes.
+
+    Each sample contributes ``t ≈ h(kind)·α + w(kind)·B·β`` with the
+    hop/byte multipliers of ITS collective kind — so reduce-scatter/
+    all-gather rows (a ZeRO run's whole timeline) are not mispriced
+    through the ring-all-reduce formula. Returns ``(alpha_s,
+    beta_s_per_byte)`` or None when the fit is degenerate (fewer than
+    2 distinct byte sizes, or a non-positive β — measurement noise on
+    tiny collectives).
+    """
+    import numpy as np
+
+    n = max(2, int(num_replicas))
+    rows = []
+    for b, kind, t in samples:
+        f = _kind_factors(kind, n)
+        if f is None:
+            continue
+        rows.append((f[0], f[1] * b, t))
+    if len({w for _, w, _ in rows}) < 2:
+        return None
+    design = np.asarray([(h, w) for h, w, _ in rows], dtype=np.float64)
+    ts = np.asarray([t for _, _, t in rows], dtype=np.float64)
+    (alpha, beta), *_ = np.linalg.lstsq(design, ts, rcond=None)
+    if beta <= 0:
+        return None
+    return float(max(alpha, 0.0)), float(beta)
+
+
+def calibrate_from_timeline(params, timeline, num_replicas,
+                            cross_node=False):
+    """Refined copy of ``params`` from collective timeline rows.
+
+    Leaves ``params`` untouched (and returns it as-is, warned) when the
+    timeline yields no usable fit.
+    """
+    samples = samples_from_timeline(timeline or [])
+    fit = fit_alpha_beta(samples, num_replicas) if samples else None
+    if fit is None:
+        logging.warning(
+            'calibrate: no usable collective samples (%d rows, %d '
+            'parsed) — keeping analytic α-β constants', len(timeline or []),
+            len(samples))
+        return params
+    alpha, beta = fit
+    import dataclasses
+    if cross_node:
+        out = dataclasses.replace(params, alpha_dcn_s=alpha,
+                                  beta_dcn_s_per_byte=beta,
+                                  calibrated=True)
+    else:
+        out = dataclasses.replace(params, alpha_ici_s=alpha,
+                                  beta_ici_s_per_byte=beta,
+                                  calibrated=True)
+    logging.info('calibrate: fitted alpha=%.3gs beta=%.3gs/B from %d '
+                 'collective samples (%s link)', alpha, beta,
+                 len(samples), 'DCN' if cross_node else 'ICI')
+    return out
+
+
+def calibrate_from_trace(params, trace_dir, num_replicas,
+                         cross_node=False, line_name='XLA Ops'):
+    """Refined copy of ``params`` from a captured profiler trace dir
+    (``Trainer.profile`` / ``RunOptions`` output). Degrades to the
+    analytic constants when the trace has no collective rows (e.g.
+    CPU-fallback runs, where profiling.collective_timeline itself
+    degrades to empty)."""
+    from autodist_tpu.utils.profiling import collective_timeline
+    timeline = collective_timeline(trace_dir, line_name=line_name)
+    return calibrate_from_timeline(params, timeline, num_replicas,
+                                   cross_node=cross_node)

@@ -1,0 +1,275 @@
+"""Analytic α-β cost model for per-variable synchronizer choices.
+
+Grounded in the PCCL formulation (per-process-group collective cost as
+α + β·bytes over link latency/bandwidth) and *Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training* (when ZeRO-style
+reduce-scatter + all-gather beats plain AllReduce):
+
+- ring all-reduce of ``B`` bytes over ``n`` devices:
+  ``2(n-1)·α + 2(n-1)/n · B·β``
+- reduce-scatter or all-gather (the two ZeRO halves):
+  ``(n-1)·α + (n-1)/n · B·β``
+
+α comes from link latency (one hop per ring step), β = 1/bandwidth.
+Which (α, β) pair applies — ICI or DCN — comes from the
+:class:`~autodist_tpu.resource_spec.Topology` hints: multi-node specs
+price collectives at the DCN link (DP reduction is the cross-boundary
+traffic; mesh.py keeps everything else on ICI).
+
+The schedule being priced is NOT re-derived here: it is the exact
+bucket/chunk layout the execution plan would emit, computed statically
+by :func:`autodist_tpu.parallel.plan.static_collective_schedule` — same
+packing, same reverse-production ordering, same ZeRO chunking. Grad-sync
+buckets other than the final one are assumed to overlap backward compute
+(the XLA latency-hiding scheduler the bucketing exists for) and get an
+``overlap_discount`` haircut; the last-emitted bucket (the FIRST layers'
+gradients, produced when no backward compute is left to hide behind) is
+always priced in full.
+"""
+from dataclasses import dataclass, field, asdict
+
+import numpy as np
+
+from autodist_tpu.parallel.plan import static_collective_schedule
+from autodist_tpu.utils import logging
+
+#: Wire bytes per element by compressor (None = tensor's own itemsize).
+#: HorovodCompressor casts f32→bf16 for the wire; Int8Ring ships int8
+#: chunks (+negligible scales). PowerSGD's wire is rank-dependent and it
+#: never fuses — priced at full bytes as a conservative bound.
+_WIRE_ITEMSIZE = {
+    'NoneCompressor': None,
+    'HorovodCompressor': 2,
+    'HorovodCompressorEF': 2,
+    'Int8RingCompressor': 1,
+}
+
+#: Grad + optimizer-slot accounting assumptions: gradients match the
+#: param dtype; optimizer slots are kept in f32 (optax default).
+_OPT_SLOT_ITEMSIZE = 4
+
+
+def wire_bytes(nbytes, dtype, compressor=None):
+    """Bytes that actually cross the wire for a raw ``nbytes`` tensor."""
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    wire = _WIRE_ITEMSIZE.get(compressor or 'NoneCompressor')
+    if wire is None or wire >= itemsize:
+        return int(nbytes)
+    return int(nbytes) * wire // itemsize
+
+
+@dataclass
+class CostModelParams:
+    """α-β constants (per link class) + overlap/compute assumptions.
+
+    ``alpha_*`` is seconds per ring hop, ``beta_*`` seconds per byte.
+    Defaults come from a :class:`Topology`'s bandwidth/latency hints;
+    :mod:`calibrate` refines them from measured collective timelines.
+    ``compute_time_s`` is an optional calibrated per-step compute
+    estimate — 0 means "rank by sync cost alone", which preserves
+    ordering (compute is strategy-invariant for a fixed model).
+    """
+    alpha_ici_s: float = 1e-6
+    beta_ici_s_per_byte: float = 1e-11        # 100 GB/s
+    alpha_dcn_s: float = 30e-6
+    beta_dcn_s_per_byte: float = 8e-9         # 0.125 GB/s
+    overlap_discount: float = 0.5             # hidden fraction of
+    # overlappable grad-bucket time (latency-hiding scheduler)
+    compute_time_s: float = 0.0
+    # compressors are not free: the wire cast reads+writes the full
+    # tensor at HBM speed on both ends (~800 GB/s, two passes)
+    compress_s_per_byte: float = 2.5e-12
+    calibrated: bool = False
+
+    @classmethod
+    def from_topology(cls, topology):
+        ici_bw, ici_lat = topology.link(cross_node=False)
+        dcn_bw, dcn_lat = topology.link(cross_node=True)
+        return cls(alpha_ici_s=ici_lat,
+                   beta_ici_s_per_byte=1.0 / ici_bw,
+                   alpha_dcn_s=dcn_lat,
+                   beta_dcn_s_per_byte=1.0 / dcn_bw)
+
+    def link(self, cross_node=False):
+        """(α seconds/hop, β seconds/byte) for one link class."""
+        if cross_node:
+            return self.alpha_dcn_s, self.beta_dcn_s_per_byte
+        return self.alpha_ici_s, self.beta_ici_s_per_byte
+
+    def to_dict(self):
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def collective_time(kind, nbytes, n, alpha, beta):
+    """Predicted seconds for ONE collective of ``nbytes`` wire bytes
+    over an ``n``-way group with link constants (α, β).
+
+    Kinds follow the schedule schema: ``all_reduce`` (ring: reduce-
+    scatter phase + all-gather phase), ``psum_scatter`` /
+    ``sparse_scatter`` (reduce-scatter half), ``all_gather`` /
+    ``sparse_all_gather`` (all-gather half).
+    """
+    n = int(n)
+    if n <= 1:
+        return 0.0
+    nbytes = float(nbytes)
+    if kind == 'all_reduce':
+        return 2 * (n - 1) * alpha + 2 * (n - 1) / n * nbytes * beta
+    if kind in ('psum_scatter', 'all_gather', 'sparse_scatter',
+                'sparse_all_gather'):
+        return (n - 1) * alpha + (n - 1) / n * nbytes * beta
+    raise ValueError('Unknown collective kind %r' % (kind,))
+
+
+@dataclass
+class CostReport:
+    """Per-strategy prediction: step time, sync decomposition, memory."""
+    predicted_step_time_s: float = 0.0
+    sync_time_s: float = 0.0           # raw (no-overlap) collective sum
+    exposed_sync_time_s: float = 0.0   # after the overlap haircut
+    predicted_peak_bytes: int = 0
+    num_collectives: int = 0
+    num_replicas: int = 1
+    cross_node: bool = False
+    memory: dict = field(default_factory=dict)
+    breakdown: list = field(default_factory=list)
+
+    def to_dict(self):
+        return asdict(self)
+
+    def summary(self):
+        """Compact dict for Strategy.cost / bench records."""
+        return {
+            'predicted_step_time_s': self.predicted_step_time_s,
+            'predicted_peak_bytes': self.predicted_peak_bytes,
+            'sync_time_s': self.sync_time_s,
+            'num_collectives': self.num_collectives,
+            'num_replicas': self.num_replicas,
+        }
+
+
+def memory_footprint(strategy, graph_item, num_replicas,
+                     optimizer_slots=2, schedule=None):
+    """Per-device peak-bytes estimate for a strategy.
+
+    Components: params + grads (param dtype), optimizer slots (f32,
+    ``optimizer_slots`` per param — 2 for Adam's mu/nu, 1 for momentum
+    SGD, 0 for plain SGD), and bucket staging (the largest grad bucket's
+    concat input + reduced output live simultaneously). ZeRO-sharded
+    (partitioned PS) variables count 1/n of their padded size for state
+    components; every replica still materializes the FULL gathered param
+    for compute, which params counts at full size.
+    """
+    n = max(1, int(num_replicas))
+    if schedule is None:
+        schedule = static_collective_schedule(strategy, graph_item, n)
+    sharded = set()
+    for e in schedule:
+        if e['kind'] in ('psum_scatter', 'sparse_scatter'):
+            sharded.update(e['members'])
+    params_b = grads_b = opt_b = 0
+    for var in graph_item.trainable_var_op_to_var.values():
+        itemsize = np.dtype(var.dtype).itemsize
+        size = int(np.prod(var.shape or (1,)))
+        nbytes = size * itemsize
+        frac = 1.0 / n if var.name in sharded and n > 1 else 1.0
+        # the gathered full param is live during compute regardless
+        params_b += nbytes
+        grads_b += int(nbytes * frac)
+        opt_b += int(size * _OPT_SLOT_ITEMSIZE * optimizer_slots * frac)
+    max_bucket = max(
+        [e['bytes'] for e in schedule
+         if e['kind'] == 'all_reduce' and e['vars'] > 1] or [0])
+    staging_b = 2 * max_bucket
+    total = params_b + grads_b + opt_b + staging_b
+    return {'params_bytes': params_b, 'grads_bytes': grads_b,
+            'optimizer_bytes': opt_b, 'bucket_staging_bytes': staging_b,
+            'total_bytes': total}
+
+
+def predict(strategy, graph_item, resource_spec=None, params=None,
+            num_replicas=None, optimizer_slots=2,
+            sparse_lookups_per_replica=4096):
+    """Price a built strategy: predicted step time + per-device memory.
+
+    Args:
+        strategy: a built :class:`Strategy`.
+        graph_item: the GraphItem it was built against (only shapes and
+            sparsity are read — nothing runs).
+        resource_spec: supplies the topology (α-β defaults) and, when
+            ``num_replicas`` is not given, the replica count. Optional
+            when both ``params`` and ``num_replicas`` are passed.
+        params: :class:`CostModelParams` override (e.g. calibrated).
+        optimizer_slots: f32 slot tensors per param for the memory
+            estimate (2 = Adam, 1 = momentum, 0 = SGD).
+
+    Returns a :class:`CostReport`.
+    """
+    if num_replicas is None:
+        num_replicas = len(strategy.graph_config.replicas)
+        if not num_replicas and resource_spec is not None:
+            num_replicas = max(1, resource_spec.num_accelerators)
+    n = max(1, int(num_replicas))
+    cross_node = False
+    if params is None:
+        if resource_spec is None:
+            raise ValueError('predict() needs resource_spec or params')
+        params = CostModelParams.from_topology(resource_spec.topology)
+    if resource_spec is not None:
+        cross_node = resource_spec.topology.multi_node
+    alpha, beta = params.link(cross_node=cross_node)
+
+    schedule = static_collective_schedule(
+        strategy, graph_item, n,
+        sparse_lookups_per_replica=sparse_lookups_per_replica)
+    breakdown = []
+    sync = 0.0
+    grad_ar = [i for i, e in enumerate(schedule)
+               if e['kind'] == 'all_reduce' and e['phase'] == 'grad']
+    last_grad_ar = grad_ar[-1] if grad_ar else -1
+    exposed = 0.0
+    for i, e in enumerate(schedule):
+        wb = wire_bytes(e['bytes'], e['dtype'], e.get('compressor'))
+        t = collective_time(e['kind'], wb, n, alpha, beta)
+        if wb < e['bytes']:   # compressor cast: two HBM passes per end
+            t += e['bytes'] * params.compress_s_per_byte
+        # grad buckets before the last-emitted one overlap backward
+        # compute; ZeRO scatters and param gathers are also pipelined
+        # but conservatively priced in full
+        overlappable = (i in grad_ar and i != last_grad_ar)
+        t_exposed = t * (1.0 - params.overlap_discount) \
+            if overlappable else t
+        sync += t
+        exposed += t_exposed
+        breakdown.append({
+            'kind': e['kind'], 'phase': e['phase'], 'vars': e['vars'],
+            'bytes': e['bytes'], 'wire_bytes': wb,
+            'time_s': t, 'exposed_time_s': t_exposed,
+            'members': e['members'][:4] + (
+                ['... %d more' % (len(e['members']) - 4)]
+                if len(e['members']) > 4 else []),
+        })
+    mem = memory_footprint(strategy, graph_item, n,
+                           optimizer_slots=optimizer_slots,
+                           schedule=schedule)
+    report = CostReport(
+        predicted_step_time_s=params.compute_time_s + exposed,
+        sync_time_s=sync,
+        exposed_sync_time_s=exposed,
+        predicted_peak_bytes=mem['total_bytes'],
+        num_collectives=len(schedule),
+        num_replicas=n,
+        cross_node=cross_node,
+        memory=mem,
+        breakdown=breakdown)
+    logging.debug('cost_model.predict: %d collectives, sync=%.3gs '
+                  'exposed=%.3gs peak=%dB over n=%d',
+                  len(schedule), sync, exposed,
+                  mem['total_bytes'], n)
+    return report

@@ -1,0 +1,132 @@
+"""Candidate enumeration + ranking over the strategy builders.
+
+Enumerates the existing builders (and their tunable knobs: AllReduce
+chunk_size — which sets the gradient-bucket byte cap — the bf16-wire
+compressor, RING spec, and the partitioned variants), prices each with
+:mod:`cost_model`, prunes candidates whose predicted per-device peak
+bytes exceed the memory budget, and returns the rest ranked by
+predicted step time.
+
+The ranking is deterministic: ties break on (peak bytes, name), and
+``RandomAxisPartitionAR`` is seeded.
+"""
+from dataclasses import dataclass
+
+from autodist_tpu.simulator import cost_model
+from autodist_tpu.utils import logging
+
+
+@dataclass
+class Candidate:
+    """One priced strategy candidate."""
+    name: str
+    strategy: object = None
+    report: object = None          # CostReport
+    feasible: bool = True
+    error: str = ''
+    rank: int = -1                 # position after sorting (0 = best)
+
+    @property
+    def predicted_step_time_s(self):
+        return self.report.predicted_step_time_s if self.report else None
+
+    @property
+    def predicted_peak_bytes(self):
+        return self.report.predicted_peak_bytes if self.report else None
+
+
+def default_candidates(chunk_sizes=(32, 128, 512)):
+    """``[(name, builder_factory)]`` covering the nine builders + knobs.
+
+    Factories (not instances): several builders carry per-build state
+    (PS load maps), so each :func:`rank` call gets fresh ones.
+    """
+    from autodist_tpu.strategy import builders as b
+    cands = []
+    for cs in chunk_sizes:
+        cands.append(('AllReduce(chunk=%d)' % cs,
+                      lambda cs=cs: b.AllReduce(chunk_size=cs)))
+    cands += [
+        ('AllReduce(bf16-wire)',
+         lambda: b.AllReduce(compressor='HorovodCompressor')),
+        ('AllReduce(RING)', lambda: b.AllReduce(all_reduce_spec='RING')),
+        ('PartitionedAR', lambda: b.PartitionedAR()),
+        ('RandomAxisPartitionAR',
+         lambda: b.RandomAxisPartitionAR(seed=0)),
+        ('Parallax', lambda: b.Parallax()),
+        ('PS', lambda: b.PS()),
+        ('PSLoadBalancing', lambda: b.PSLoadBalancing()),
+        ('PartitionedPS', lambda: b.PartitionedPS()),
+        ('UnevenPartitionedPS', lambda: b.UnevenPartitionedPS()),
+    ]
+    return cands
+
+
+def rank(graph_item, resource_spec, candidates=None,
+         memory_budget_bytes=None, params=None, num_replicas=None,
+         optimizer_slots=2, sparse_lookups_per_replica=4096):
+    """Build + price every candidate; return (feasible, infeasible).
+
+    ``feasible`` is sorted by (predicted step time, peak bytes, name)
+    and each entry's ``strategy.cost`` carries the prediction summary.
+    ``infeasible`` holds candidates pruned by the memory budget or whose
+    build raised (with ``error`` set) — kept for the ranked table.
+    """
+    if candidates is None:
+        candidates = default_candidates()
+    feasible, infeasible = [], []
+    for name, factory in candidates:
+        cand = Candidate(name=name)
+        try:
+            strategy = factory().build(graph_item, resource_spec)
+            report = cost_model.predict(
+                strategy, graph_item, resource_spec, params=params,
+                num_replicas=num_replicas,
+                optimizer_slots=optimizer_slots,
+                sparse_lookups_per_replica=sparse_lookups_per_replica)
+        except Exception as e:   # noqa: BLE001 - one bad candidate
+            # must not kill the search (e.g. a builder that needs
+            # devices this spec does not have)
+            cand.feasible = False
+            cand.error = '%s: %s' % (type(e).__name__, e)
+            logging.warning('simulator: candidate %s failed to build '
+                            '(%s)', name, cand.error)
+            infeasible.append(cand)
+            continue
+        cand.strategy = strategy
+        cand.report = report
+        strategy.cost = dict(report.summary(), builder=name)
+        if memory_budget_bytes is not None and \
+                report.predicted_peak_bytes > memory_budget_bytes:
+            cand.feasible = False
+            cand.error = ('predicted peak %d B exceeds budget %d B'
+                          % (report.predicted_peak_bytes,
+                             memory_budget_bytes))
+            infeasible.append(cand)
+            continue
+        feasible.append(cand)
+    feasible.sort(key=lambda c: (c.report.predicted_step_time_s,
+                                 c.report.predicted_peak_bytes, c.name))
+    for i, c in enumerate(feasible):
+        c.rank = i
+        c.strategy.cost['rank'] = i
+    return feasible, infeasible
+
+
+def format_ranked_table(feasible, infeasible=()):
+    """Human-readable ranked table (tools/simulate.py output)."""
+    rows = []
+    header = ('%-4s %-26s %14s %12s %8s'
+              % ('#', 'candidate', 'pred step (ms)', 'peak (MiB)',
+                 'colls'))
+    rows.append(header)
+    rows.append('-' * len(header))
+    for c in feasible:
+        rows.append('%-4d %-26s %14.4f %12.1f %8d'
+                    % (c.rank, c.name,
+                       c.report.predicted_step_time_s * 1e3,
+                       c.report.predicted_peak_bytes / (1 << 20),
+                       c.report.num_collectives))
+    for c in infeasible:
+        rows.append('---  %-26s pruned: %s' % (c.name, c.error))
+    return '\n'.join(rows)

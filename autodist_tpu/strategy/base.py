@@ -122,13 +122,19 @@ class Strategy:
         self.path = os.path.join(DEFAULT_SERIALIZATION_DIR, self.id)
         self.node_config = []      # list[StrategyNode]
         self.graph_config = GraphConfig()
+        # predicted-cost metadata attached by the simulator (AutoStrategy
+        # / simulator.search): {'builder', 'predicted_step_time_s',
+        # 'predicted_peak_bytes', ...}. None for hand-built strategies.
+        # Rides serialization so workers and audits see what the chief
+        # predicted.
+        self.cost = None
 
     # -- (de)serialization ------------------------------------------------
     def to_dict(self):
         def enc_sync(s):
             return asdict(s) if s is not None else None
 
-        return {
+        out = {
             'id': self.id,
             'node_config': [{
                 'var_name': n.var_name,
@@ -138,6 +144,9 @@ class Strategy:
             } for n in self.node_config],
             'graph_config': {'replicas': list(self.graph_config.replicas)},
         }
+        if self.cost is not None:
+            out['cost'] = dict(self.cost)
+        return out
 
     @classmethod
     def from_dict(cls, d):
@@ -156,6 +165,7 @@ class Strategy:
             s.node_config.append(node)
         s.graph_config = GraphConfig(
             replicas=list(d['graph_config']['replicas']))
+        s.cost = dict(d['cost']) if d.get('cost') is not None else None
         return s
 
     def serialize(self):

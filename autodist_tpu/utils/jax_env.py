@@ -11,6 +11,23 @@ import os
 import re
 
 
+def force_cpu_host_devices(n=8):
+    """Arm the n-virtual-device CPU fallback BEFORE jax's backend
+    initializes: append ``xla_force_host_platform_device_count`` to
+    ``XLA_FLAGS`` if absent (flags are read once at backend init).
+    Shared by bench.py's UNAVAILABLE fallback and tools/simulate.py;
+    tests/conftest.py keeps its own copy on purpose (the test bootstrap
+    must not depend on package imports). Callers import jax afterwards
+    and, on images whose sitecustomize pins the platform, also call
+    :func:`apply_jax_env_overrides`.
+    """
+    if 'xla_force_host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d' % n).strip()
+
+
 def apply_jax_env_overrides():
     import jax
 

@@ -28,6 +28,8 @@ import os
 import re
 from collections import defaultdict
 
+from autodist_tpu.utils import logging
+
 _CATEGORY_RULES = (
     ('pallas-kernel', re.compile(r'pallas|custom-call')),
     ('convolution', re.compile(r'^convolution')),
@@ -75,9 +77,21 @@ def per_op_breakdown(trace_dir, line_name='XLA Ops'):
     files = sorted(glob.glob(os.path.join(trace_dir, '**', '*.xplane.pb'),
                              recursive=True), key=os.path.getmtime)
     if not files:
+        if os.path.isdir(trace_dir):
+            logging.warning(
+                'profiling: trace dir %s exists but holds no '
+                '*.xplane.pb; returning empty breakdown', trace_dir)
         return {}
-    from jax.profiler import ProfileData
-    pd = ProfileData.from_file(files[-1])
+    try:
+        from jax.profiler import ProfileData
+        pd = ProfileData.from_file(files[-1])
+    except Exception as e:   # noqa: BLE001 - degrade, never raise:
+        # calibration/bench consumers run on CPU-fallback hosts whose
+        # traces may be partial or whose jax lacks ProfileData
+        logging.warning('profiling: cannot parse trace %s (%s: %s); '
+                        'returning empty breakdown', files[-1],
+                        type(e).__name__, e)
+        return {}
     # the busiest device plane's per-op line (real hardware traces);
     # CPU-backend traces carry only host execution lines, so fall back
     # to the busiest line anywhere — a coarse program-level view rather
@@ -101,6 +115,9 @@ def per_op_breakdown(trace_dir, line_name='XLA Ops'):
         if best is not None:
             break
     if best is None:
+        logging.warning(
+            "profiling: trace in %s has no '%s' (or host) timeline; "
+            'returning empty breakdown', trace_dir, line_name)
         return {}
     by_cat = defaultdict(int)
     by_op = defaultdict(lambda: [0, 0])
@@ -160,6 +177,8 @@ def collective_timeline(trace_dir, line_name='XLA Ops'):
     """
     rep = per_op_breakdown(trace_dir, line_name=line_name)
     if not rep:
+        # per_op_breakdown already warned with the specific cause;
+        # callers (calibration) degrade on the empty timeline
         return []
     rows = []
     for name, ns, cnt in rep['top_ops']:

@@ -35,6 +35,19 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert gs['bucket_count'] >= 1
     assert gs['per_step_sync_time_s'] > 0
     assert gs['sync_bytes'] > 0
+    # ISSUE 2: every record carries the simulator block — the chosen
+    # plan plus prediction AND measurement for each candidate run
+    sim = extra['simulator']
+    assert sim['chosen_strategy']
+    assert sim['predicted_step_time_s'] > 0
+    assert sim['predicted_peak_bytes'] > 0
+    measured = [c for c in sim['candidates']
+                if 'measured_step_time_s' in c]
+    assert measured, sim['candidates']
+    for c in measured:
+        assert c['predicted_step_time_s'] > 0
+        assert c['measured_step_time_s'] > 0
+    assert any(c['name'].endswith('[auto]') for c in measured)
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

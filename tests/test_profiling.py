@@ -69,3 +69,37 @@ def test_categorizer_uses_op_name_not_operands():
 def test_empty_dir_returns_empty(tmp_path):
     assert per_op_breakdown(str(tmp_path)) == {}
     assert format_breakdown({}) == '(no trace data)'
+
+
+def test_corrupt_trace_degrades_to_empty(tmp_path):
+    """ISSUE 2 satellite: a trace dir that exists but cannot be parsed
+    (or has no matching timeline) must return an empty result with a
+    logged warning, not raise — calibration degrades gracefully on
+    CPU-fallback runs."""
+    from autodist_tpu.utils.profiling import collective_timeline
+    (tmp_path / 'bogus.xplane.pb').write_bytes(b'\x00not a real xplane')
+    assert per_op_breakdown(str(tmp_path)) == {}
+    assert collective_timeline(str(tmp_path)) == []
+
+
+def test_missing_line_name_degrades_to_empty(tmp_path):
+    """A real trace aggregated under a line name it does not contain
+    must degrade to empty (device planes only carry 'XLA Ops')."""
+    if not _has_profile_data():
+        pytest.skip('jax.profiler.ProfileData unavailable (older jax)')
+    import jax as _jax
+
+    @_jax.jit
+    def step(a):
+        return (a @ a).sum()
+
+    a = jnp.asarray(np.random.RandomState(0).randn(16, 16).astype('f4'))
+    step(a).block_until_ready()
+    _jax.profiler.start_trace(str(tmp_path))
+    step(a).block_until_ready()
+    _jax.profiler.stop_trace()
+    # a line name no plane carries: host fallback may still aggregate
+    # SOMETHING (coarse program view) — the contract is "no raise, and
+    # empty-or-dict", never an exception
+    rep = per_op_breakdown(str(tmp_path), line_name='No Such Line')
+    assert isinstance(rep, dict)

@@ -74,3 +74,71 @@ def test_duplicate_node_rejected():
     with pytest.raises(ValueError):
         make_spec({'nodes': [{'address': 'a', 'gpus': [0]},
                              {'address': 'a', 'gpus': [1]}]})
+
+
+# -- topology hints (ISSUE 2: validated at parse time — the simulator
+# consumes them blindly) --------------------------------------------------
+
+def test_topology_defaults_by_device_type():
+    tpu = make_spec({'nodes': [{'address': 'h', 'tpus': [0, 1],
+                                'network_bandwidth': 100}]})
+    cpu = make_spec({'nodes': [{'address': 'h', 'cpus': [0],
+                                'network_bandwidth': 100}]})
+    assert tpu.topology.ici_bandwidth_gbps > cpu.topology.ici_bandwidth_gbps
+    # DCN default derives from network_bandwidth (GBE -> GB/s)
+    assert tpu.topology.dcn_bandwidth_gbps == pytest.approx(100 / 8.0)
+    bw, lat = tpu.topology.link(cross_node=False)
+    assert bw > 0 and lat > 0
+
+
+def test_topology_overrides_and_device_kind():
+    r = make_spec({'nodes': [{'address': 'h', 'tpus': [0],
+                              'network_bandwidth': 100}],
+                   'topology': {'ici_bandwidth_gbps': 45.5,
+                                'dcn_latency_us': 99,
+                                'device_kind': 'v5e'}})
+    assert r.topology.ici_bandwidth_gbps == 45.5
+    assert r.topology.dcn_latency_us == 99
+    assert r.topology.device_kind == 'v5e'
+
+
+@pytest.mark.parametrize('bad_field', [
+    'ici_bandwidth_gbps', 'ici_latency_us',
+    'dcn_bandwidth_gbps', 'dcn_latency_us'])
+@pytest.mark.parametrize('bad_value', [0, -3, 'fast', True])
+def test_topology_rejects_non_positive_values(bad_field, bad_value):
+    with pytest.raises(ValueError, match=bad_field):
+        make_spec({'nodes': [{'address': 'h', 'tpus': [0],
+                              'network_bandwidth': 100}],
+                   'topology': {bad_field: bad_value}})
+
+
+def test_topology_rejects_unknown_device_kind():
+    with pytest.raises(ValueError, match='quantum9000'):
+        make_spec({'nodes': [{'address': 'h', 'tpus': [0],
+                              'network_bandwidth': 100}],
+                   'topology': {'device_kind': 'quantum9000'}})
+
+
+def test_topology_rejects_unknown_fields():
+    with pytest.raises(ValueError, match='ici_bandwith'):
+        make_spec({'nodes': [{'address': 'h', 'tpus': [0],
+                              'network_bandwidth': 100}],
+                   'topology': {'ici_bandwith': 100}})   # typo'd field
+
+
+def test_non_positive_network_bandwidth_rejected():
+    for bad in (0, -1, 'big'):
+        with pytest.raises(ValueError, match='network_bandwidth'):
+            make_spec({'nodes': [{'address': 'h', 'tpus': [0],
+                                  'network_bandwidth': bad}]})
+
+
+def test_multi_node_topology_flag():
+    r = make_spec({'nodes': [
+        {'address': 'a', 'tpus': [0], 'chief': True,
+         'network_bandwidth': 10},
+        {'address': 'b', 'tpus': [0], 'network_bandwidth': 25}]})
+    assert r.topology.multi_node
+    # DCN defaults from the SLOWEST node's bandwidth
+    assert r.topology.dcn_bandwidth_gbps == pytest.approx(10 / 8.0)

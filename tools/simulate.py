@@ -1,0 +1,144 @@
+"""Rank sync/partition strategies for a model + resource spec — offline.
+
+Prints the simulator's ranked table (predicted step time, per-device
+peak bytes, collective count per candidate builder) WITHOUT running a
+single training step: only ``jax.eval_shape`` touches the model, so
+this works on a TPU-less host.
+
+Runs under the CPU fallback::
+
+    JAX_PLATFORMS=cpu python tools/simulate.py --model ncf
+    python tools/simulate.py --model lstm --resource-spec cluster.yml \
+        --budget-gb 8 --json
+
+Without ``--resource-spec`` a single-node spec is synthesized from
+``--devices`` / ``--device-type`` (topology hints then come from the
+per-type defaults; pass a YAML spec with a ``topology:`` block to price
+a real mesh).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU fallback BEFORE any jax import: 8 virtual devices (jax_env is
+# jax-import-free at module level, so this is safe to import first)
+from autodist_tpu.utils.jax_env import (  # noqa: E402
+    apply_jax_env_overrides, force_cpu_host_devices)
+
+force_cpu_host_devices(8)
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+apply_jax_env_overrides()
+
+
+def build_model(name):
+    """Model registry for the bench model set (shapes only — no steps).
+
+    Returns (model, optimizer_slots).
+    """
+    import jax.numpy as jnp
+    if name == 'ncf':
+        from autodist_tpu.models.ncf import NCF
+        return NCF(138493, 26744, mf_dim=64, mlp_dims=(256, 128, 64)), 2
+    if name == 'lstm':
+        from autodist_tpu.models.rnn import LSTMLM
+        return LSTMLM(vocab=100000, dim=512, hidden=1024, n_layers=2), 2
+    if name == 'tinylm':
+        from autodist_tpu.models.transformer import (TransformerConfig,
+                                                     TransformerLM)
+        return TransformerLM(TransformerConfig.tiny(
+            dtype=jnp.float32)), 2
+    if name == 'resnet':
+        from autodist_tpu.models.vision import ResNet
+        return ResNet((1, 1), num_classes=10, dtype=jnp.float32), 1
+    raise SystemExit('unknown --model %r (ncf, lstm, tinylm, resnet)'
+                     % name)
+
+
+def build_resource_spec(args):
+    from autodist_tpu.resource_spec import ResourceSpec
+    if args.resource_spec:
+        return ResourceSpec(resource_file=args.resource_spec)
+    node = {'address': 'localhost', 'chief': True, 'cpus': [0],
+            'network_bandwidth': 100}
+    key = {'tpu': 'tpus', 'gpu': 'gpus', 'cpu': 'cpus'}[args.device_type]
+    if args.device_type == 'cpu':
+        node['cpus'] = list(range(args.devices))
+    else:
+        node[key] = list(range(args.devices))
+    return ResourceSpec(resource_info={'nodes': [node]})
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Simulate strategy candidates (no training runs).')
+    p.add_argument('--model', default='tinylm',
+                   help='ncf | lstm | tinylm | resnet')
+    p.add_argument('--resource-spec', default='',
+                   help='YAML resource spec (else synthesized)')
+    p.add_argument('--devices', type=int, default=8,
+                   help='device count for the synthesized spec')
+    p.add_argument('--device-type', default='tpu',
+                   choices=('tpu', 'gpu', 'cpu'),
+                   help='device type for the synthesized spec')
+    p.add_argument('--replicas', type=int, default=0,
+                   help='override the replica count priced (default: '
+                        'the spec accelerator count)')
+    p.add_argument('--budget-gb', type=float, default=0,
+                   help='per-device memory budget; 0 = no pruning')
+    p.add_argument('--optimizer-slots', type=int, default=None,
+                   help='f32 slots per param (default per model: '
+                        '2 Adam-like, 1 momentum)')
+    p.add_argument('--calibrate-trace', default='',
+                   help='profiler trace dir to refine alpha-beta from')
+    p.add_argument('--json', action='store_true',
+                   help='emit one JSON object instead of the table')
+    args = p.parse_args(argv)
+
+    from autodist_tpu.simulator import search
+    from autodist_tpu.simulator.calibrate import calibrate_from_trace
+    from autodist_tpu.simulator.cost_model import CostModelParams
+    from autodist_tpu.strategy.adapter import PytreeGraphItem
+
+    model, default_slots = build_model(args.model)
+    slots = args.optimizer_slots if args.optimizer_slots is not None \
+        else default_slots
+    rs = build_resource_spec(args)
+    gi = PytreeGraphItem(model)
+    params = CostModelParams.from_topology(rs.topology)
+    n = args.replicas or None
+    if args.calibrate_trace:
+        from autodist_tpu.strategy.builders import replica_devices
+        params = calibrate_from_trace(
+            params, args.calibrate_trace,
+            n or len(replica_devices(rs)),
+            cross_node=rs.topology.multi_node)
+    budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
+    feasible, infeasible = search.rank(
+        gi, rs, memory_budget_bytes=budget, params=params,
+        num_replicas=n, optimizer_slots=slots)
+    if args.json:
+        print(json.dumps({
+            'model': args.model,
+            'topology': repr(rs.topology),
+            'memory_budget_bytes': budget,
+            'candidates': [
+                dict(c.strategy.cost, feasible=True)
+                for c in feasible] + [
+                {'builder': c.name, 'feasible': False, 'error': c.error}
+                for c in infeasible],
+        }))
+        return 0
+    print('model=%s  vars=%d  %r  replicas=%d%s' % (
+        args.model, len(gi.trainable_var_op_to_var), rs.topology,
+        feasible[0].report.num_replicas if feasible else 0,
+        '  budget=%.1fGB' % args.budget_gb if budget else ''))
+    print(search.format_ranked_table(feasible, infeasible))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
