@@ -57,6 +57,26 @@ DEFAULT_CHUNK_SIZE = 128
 BUCKET_BYTES_PER_CHUNK = 256 << 10
 
 
+def _positive_float(name, raw, default):
+    """Validated env parse: a strictly positive float."""
+    if not raw:
+        return default
+    val = float(raw)
+    if val <= 0:
+        raise ValueError('%s must be > 0; got %r' % (name, raw))
+    return val
+
+
+def _min_int(name, raw, default, lo):
+    """Validated env parse: an integer >= ``lo``."""
+    if not raw:
+        return default
+    val = int(raw)
+    if val < lo:
+        raise ValueError('%s must be >= %d; got %r' % (name, lo, raw))
+    return val
+
+
 class ENV(Enum):
     """Typed environment flags, each with a default-producing lambda.
 
@@ -125,6 +145,22 @@ class ENV(Enum):
     # cap and base backoff for reads raced by concurrent pushes.
     AUTODIST_PS_TORN_RETRIES = (lambda v: int(v) if v else 100,)
     AUTODIST_PS_TORN_BACKOFF_S = (lambda v: float(v) if v else 0.01,)
+    # torn-read stall window (coord_client.vget/vmget): how long a pull
+    # waits for an in-flight chunked write whose version has stopped
+    # advancing before declaring the writer dead. Must cover one full
+    # chunk frame's encode+wire time; tests shrink it.
+    AUTODIST_PS_STALL_TIMEOUT_S = \
+        (lambda v: _positive_float('AUTODIST_PS_STALL_TIMEOUT_S', v,
+                                   10.0),)
+    # loose-mode PS pipeline depth (runtime/session.py): 1 = the serial
+    # pull -> step -> push data plane (bit-exact legacy semantics);
+    # 2 = one step of overlap — step N's delta push + publish and step
+    # N+1's variable pull run on a background pipeline thread, hidden
+    # behind N's host tail. Values > 2 clamp to 2 (a pull must follow
+    # the previous push of the same variable, so at most one step can
+    # be in flight without breaking read-your-writes).
+    AUTODIST_PS_PIPELINE_DEPTH = \
+        (lambda v: _min_int('AUTODIST_PS_PIPELINE_DEPTH', v, 1, lo=1),)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

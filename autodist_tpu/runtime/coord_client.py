@@ -19,12 +19,24 @@ declaring the byte count, then the raw tensor bytes — f32 or bf16 on the
 wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at rest on the service. This is
 the grpc-data-plane equivalent the reference rode for PS traffic; base64
 text framing (33% inflation, full-line buffering) is gone.
+
+The multi-tensor variants (:meth:`CoordClient.vmget` / ``vmset`` /
+``vmadd``) PIPELINE their RPCs: all request frames are written ahead of
+draining the replies on the same socket, so a pull of N chunks pays one
+wire round trip instead of N. The service protocol is strictly
+sequential per connection (one request fully handled before the next is
+read), which is exactly what makes this safe — replies come back in
+request order. :class:`TransferPool` supplies the persistent
+per-endpoint worker threads (one dedicated connection each) the session
+drives these through.
 """
 import hashlib
 import hmac as hmac_mod
 import os
+import queue
 import socket
 import subprocess
+import threading
 import time
 
 import numpy as np
@@ -71,12 +83,29 @@ def _wire_dtype(wire=None):
     return wire
 
 
+def _as_f32_flat(value):
+    """Host value -> flat contiguous float32 array WITHOUT copying when
+    the input already conforms — the common hot-path case (session
+    deltas and pulled buffers are contiguous float32 already). Only a
+    wrong dtype or non-contiguous layout pays a copy."""
+    arr = np.asarray(value)
+    if arr.dtype != np.float32:
+        arr = arr.astype(np.float32)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return arr.reshape(-1)
+
+
 def _encode(arr, wire):
-    """float32 host array -> raw wire bytes."""
-    arr = np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    """float32 host array -> raw wire bytes.
+
+    The f32 path returns a zero-copy memoryview over the source array
+    (``tobytes`` paid a full payload copy per frame); callers must not
+    mutate the source until the frame is sent."""
+    arr = _as_f32_flat(arr)
     if wire == 'bf16':
         return arr.astype(_BF16).tobytes()
-    return arr.tobytes()
+    return memoryview(arr).cast('B')
 
 
 def _decode(raw, wire):
@@ -175,8 +204,19 @@ class CoordClient:
     # How long a torn pull waits for an in-flight chunked write whose
     # version has stopped advancing before declaring the writer dead.
     # Must cover one full chunk frame's encode+wire time (the version
-    # only moves per landed frame); tests shrink it.
+    # only moves per landed frame); tests shrink it, deployments tune
+    # it via AUTODIST_PS_STALL_TIMEOUT_S (see stall_timeout_s).
     STALL_TIMEOUT_S = 10.0
+
+    @property
+    def stall_timeout_s(self):
+        """The torn-read stall window: ``AUTODIST_PS_STALL_TIMEOUT_S``
+        when set (validated > 0 in const.py like the sibling
+        TORN_RETRIES/BACKOFF knobs), else the class default — which
+        tests shrink by patching :attr:`STALL_TIMEOUT_S`."""
+        if os.environ.get(ENV.AUTODIST_PS_STALL_TIMEOUT_S.name):
+            return ENV.AUTODIST_PS_STALL_TIMEOUT_S.val
+        return self.STALL_TIMEOUT_S
 
     def __init__(self, address=None, timeout=None, op_timeout=None):
         if address is None:
@@ -243,19 +283,46 @@ class CoordClient:
         if resp != 'OK':
             raise OSError('coord service rejected auth: %s' % resp)
 
-    def _rpc(self, line, payload=None):
-        """Send one request (header line + optional raw payload), read the
-        reply header line."""
+    def _send_frame(self, line, payload=None):
+        """Write one request frame (header line + optional raw payload)
+        WITHOUT reading its reply — the building block the pipelined
+        multi-tensor calls (vmget/vmset/vmadd) write batches of."""
         header = line.encode() + b'\n'
-        if payload and len(payload) > 65536:
+        if payload is not None and len(payload) > 65536:
             # large tensor frames: send header + payload separately to
             # avoid a whole-payload concat copy (TCP_NODELAY is set, and
             # the payload write follows immediately, so no Nagle stall)
             self._sock.sendall(header)
             self._sock.sendall(payload)
+        elif payload is not None and len(payload):
+            # payload may be a zero-copy memoryview (_encode f32 path)
+            self._sock.sendall(header + bytes(payload))
         else:
-            self._sock.sendall(header + payload if payload else header)
+            self._sock.sendall(header)
+
+    def _rpc(self, line, payload=None):
+        """Send one request (header line + optional raw payload), read the
+        reply header line."""
+        self._send_frame(line, payload)
         return self._read_reply_line()
+
+    def _pipelined(self, frames, on_reply, window=32):
+        """Write request ``frames`` (``(token, line, payload)``) ahead of
+        reading replies, keeping at most ``window`` replies outstanding;
+        ``on_reply(token)`` must consume exactly one reply from the
+        socket. The service handles one request per connection at a time
+        and replies in request order, so pipelining is safe; the window
+        bounds how far the writer runs ahead so the two directions'
+        socket buffers can never both fill (the classic pipelining
+        deadlock)."""
+        outstanding = []
+        for token, line, payload in frames:
+            self._send_frame(line, payload)
+            outstanding.append(token)
+            if len(outstanding) >= window:
+                on_reply(outstanding.pop(0))
+        while outstanding:
+            on_reply(outstanding.pop(0))
 
     def _read_exact(self, nbytes):
         """Read exactly ``nbytes`` of reply payload (after a VAL header)."""
@@ -357,115 +424,189 @@ class CoordClient:
         return [(off, min(chunk, n_elems - off))
                 for off in range(0, n_elems, chunk)]
 
-    def vset(self, key, value, wire=None):
-        """Store a tensor (authoritative PS copy). Stored f32; wire dtype
-        per ``AUTODIST_PS_WIRE_DTYPE``; frames above the chunk limit move
-        as ranged chunks (elementwise, so chunked application is exact)."""
-        wire = _wire_dtype(wire)
-        flat = np.ascontiguousarray(
-            np.asarray(value, dtype=np.float32)).reshape(-1)
+    def _set_frames(self, key, value, wire):
+        """The BSET frame sequence for one tensor (chunked like vset)."""
+        # _as_f32_flat skips the copy the old
+        # ascontiguousarray(asarray(...)) pair paid even on
+        # already-conforming input — the common session hot path
+        flat = _as_f32_flat(value)
         ranges = self._ranges(flat.size, wire)
         for off, count in ranges:
             payload = _encode(flat[off:off + count], wire)
             suffix = '' if len(ranges) == 1 else \
                 ' %d %d' % (off, flat.size)
-            resp = self._rpc('BSET %s %d %s%s'
-                             % (key, len(payload), wire, suffix), payload)
+            yield (key, 'BSET %s %d %s%s'
+                   % (key, len(payload), wire, suffix), payload)
+
+    def vset(self, key, value, wire=None):
+        """Store a tensor (authoritative PS copy). Stored f32; wire dtype
+        per ``AUTODIST_PS_WIRE_DTYPE``; frames above the chunk limit move
+        as ranged chunks (elementwise, so chunked application is exact)."""
+        self.vmset([(key, value)], wire=wire)
+
+    def vmset(self, items, wire=None):
+        """Pipelined multi-tensor :meth:`vset`: every (key, value) in
+        ``items`` is stored with vset's exact chunking, but all request
+        frames are written ahead of draining the replies — one wire
+        round trip for the whole batch instead of one per chunk."""
+        wire = _wire_dtype(wire)
+        frames = [f for key, value in items
+                  for f in self._set_frames(key, value, wire)]
+        errs = []
+
+        def reply(key):
+            resp = self._read_reply_line()
             if resp != 'OK':
-                raise OSError('BSET %s failed: %s' % (key, resp))
+                errs.append('BSET %s failed: %s' % (key, resp))
+
+        self._pipelined(frames, reply)
+        if errs:
+            raise OSError('; '.join(errs))
 
     def vget(self, key, shape=None, dtype=np.float32, wire=None):
         """Fetch a tensor as float32 host array, or None if absent.
         With a known ``shape``, oversized tensors are pulled as ranged
-        chunks.
+        chunks. Single-key form of :meth:`vmget` (one torn-read
+        implementation serves both)."""
+        return self.vmget([(key, shape)], dtype=dtype, wire=wire)[0]
+
+    def vmget(self, specs, dtype=np.float32, wire=None):
+        """Pipelined multi-tensor fetch: ``specs`` is ``[(key, shape)]``;
+        returns one float32 array (or None if absent) per spec. ALL
+        chunk requests for every pending key are written ahead of
+        draining the replies, so a pull of K keys x C chunks pays one
+        wire round trip instead of K*C.
 
         Torn-read safe (ADVICE r4): every BGET opts into the server's
         version field ("v" flag → ``version*2 + write_in_progress``).
         An odd value means a chunked write is mid-flight; a value that
-        moves between this pull's chunks means a push landed between
-        them. Either way the whole pull retries. Old servers without
-        the field degrade to the previous (unchecked) behavior."""
+        moves between one key's chunks means a push landed between
+        them. Either way that key's pull retries (only torn keys
+        re-request). Old servers without the field degrade to the
+        previous (unchecked) behavior.
+
+        Retry policy: while a key's version ADVANCES between attempts
+        the writer is alive and making progress (a multi-GB chunked
+        push legitimately holds the flag for seconds) — keep waiting,
+        up to a configurable cap (AUTODIST_PS_TORN_RETRIES /
+        AUTODIST_PS_TORN_BACKOFF_S).  The version only moves when a
+        whole chunk frame lands, and one frame can take
+        AUTODIST_PS_CHUNK_BYTES of wire time, so "stalled" is judged
+        on a wall-clock window (``stall_timeout_s``), not an attempt
+        count: a version that stays odd AND unchanged that long is
+        the dead-mid-push signature.
+
+        Exhausting the cap is only an ERROR when parity is odd (a
+        write is genuinely mid-chunk: returning would hand back a
+        half-applied tensor). An even version that merely keeps
+        MOVING between one key's chunks means whole pushes keep
+        landing — element-level staleness, the same benign mix any
+        reader of a concurrently-updated accumulator sees — so the
+        final assembly is returned with a warning instead of killing
+        a healthy worker under frequent pushes. Caveat: each chunk of
+        the assembly comes from a COMPLETE push, but different chunks
+        may come from consecutive pushes — fine for commutative BADD
+        accumulation and for fetch-side staleness, but a reader that
+        needs one specific BSET snapshot must quiesce writers (the
+        staleness gate) rather than rely on this path."""
         wire = _wire_dtype(wire)
-        n_elems = int(np.prod(shape)) if shape is not None else None
-        ranges = self._ranges(n_elems, wire) if n_elems else [(0, None)]
-        # Retry policy: while the version ADVANCES between attempts the
-        # writer is alive and making progress (a multi-GB chunked push
-        # legitimately holds the flag for seconds) — keep waiting, up
-        # to a configurable cap (AUTODIST_PS_TORN_RETRIES /
-        # AUTODIST_PS_TORN_BACKOFF_S).  The version only moves when a
-        # whole chunk frame lands, and one frame can take
-        # AUTODIST_PS_CHUNK_BYTES of wire time, so "stalled" is judged
-        # on a wall-clock window (STALL_TIMEOUT_S), not an attempt
-        # count: a version that stays odd AND unchanged that long is
-        # the dead-mid-push signature.
-        #
-        # Exhausting the cap is only an ERROR when parity is odd (a
-        # write is genuinely mid-chunk: returning would hand back a
-        # half-applied tensor). An even version that merely keeps
-        # MOVING between this pull's chunks means whole pushes keep
-        # landing — element-level staleness, the same benign mix any
-        # reader of a concurrently-updated accumulator sees — so the
-        # final assembly is returned with a warning instead of killing
-        # a healthy worker under frequent pushes. Caveat: each chunk of
-        # the assembly comes from a COMPLETE push, but different chunks
-        # may come from consecutive pushes — fine for commutative BADD
-        # accumulation and for fetch-side staleness, but a reader that
-        # needs one specific BSET snapshot must quiesce writers (the
-        # staleness gate) rather than rely on this path.
+        specs = list(specs)
+        n_elems = [int(np.prod(shp)) if shp is not None else None
+                   for _, shp in specs]
+        ranges = [self._ranges(n, wire) if n else [(0, None)]
+                  for n in n_elems]
+        results = [None] * len(specs)
         max_attempts = max(1, ENV.AUTODIST_PS_TORN_RETRIES.val)
         backoff = ENV.AUTODIST_PS_TORN_BACKOFF_S.val
-        last_ver = None
-        last_progress = time.monotonic()
+        stall_s = self.stall_timeout_s
+        last_ver = {}         # idx -> last version seen while torn
+        last_progress = {}    # idx -> local time the version last moved
+        pending = list(range(len(specs)))
         for attempt in range(max_attempts):
-            parts = []
-            first_ver = None
-            odd = False
-            torn = False
-            for off, count in ranges:
-                suffix = '' if len(ranges) == 1 and off == 0 and \
-                    (count is None or count == n_elems) else \
-                    ' %d %d' % (off, count)
-                resp = self._rpc('BGET %s %s%s v' % (key, wire, suffix))
+            final = attempt == max_attempts - 1
+            frames = []
+            for idx in pending:
+                key = specs[idx][0]
+                for off, count in ranges[idx]:
+                    suffix = '' if len(ranges[idx]) == 1 and off == 0 \
+                        and (count is None or count == n_elems[idx]) \
+                        else ' %d %d' % (off, count)
+                    frames.append((idx, 'BGET %s %s%s v'
+                                   % (key, wire, suffix), None))
+            parts = {idx: [] for idx in pending}
+            first_ver = {}
+            cur_ver = {}
+            odd = set()
+            torn = set()
+            absent = set()
+            errors = []
+
+            def reply(idx):
+                resp = self._read_reply_line()
                 if resp == 'NONE':
-                    return None
+                    absent.add(idx)
+                    return
                 if not resp.startswith('VAL'):
-                    raise OSError('BGET %s failed: %s' % (key, resp))
+                    # keep draining the remaining replies (the stream
+                    # stays framed); raise once the batch is consumed
+                    errors.append('BGET %s failed: %s'
+                                  % (specs[idx][0], resp))
+                    return
                 fields = resp.split()
-                parts.append(
+                parts[idx].append(
                     _decode(self._read_exact(int(fields[1])), wire))
                 ver = int(fields[2]) if len(fields) > 2 else None
-                if ver is not None and ver & 1:  # write in progress
-                    odd = torn = True
-                elif first_ver is None:
-                    first_ver = ver
-                elif ver != first_ver:
-                    torn = True
-                if torn:
-                    if ver != last_ver:
-                        last_ver = ver
-                        last_progress = time.monotonic()
-                    if not (attempt == max_attempts - 1 and not odd):
-                        break   # final even-skew pass reads to the end
-            if not torn or (attempt == max_attempts - 1 and not odd):
-                if torn:
-                    logging.warning(
-                        'BGET %s: version kept advancing for %d '
-                        'attempts (concurrent single-frame pushes); '
-                        'returning the last assembly — element-level '
-                        'staleness only, parity was even throughout '
-                        'the final pass', key, max_attempts)
-                arr = parts[0] if len(parts) == 1 else \
-                    np.concatenate(parts)
-                if shape is not None:
-                    arr = arr.reshape(shape)
-                return arr.astype(dtype, copy=False)
-            if odd and time.monotonic() - last_progress > \
-                    self.STALL_TIMEOUT_S:
-                raise OSError(
-                    'BGET %s: a chunked write is stuck mid-flight '
-                    '(version parity odd and not advancing for %.0fs) '
-                    '— a peer likely died mid-push'
-                    % (key, self.STALL_TIMEOUT_S))
+                if ver is None:
+                    return
+                cur_ver[idx] = ver
+                if ver & 1:  # write in progress
+                    odd.add(idx)
+                    torn.add(idx)
+                elif idx not in first_ver:
+                    first_ver[idx] = ver
+                elif ver != first_ver[idx]:
+                    torn.add(idx)
+
+            self._pipelined(frames, reply)
+            if errors:
+                raise OSError('; '.join(errors))
+            now = time.monotonic()
+            retry = []
+            for idx in pending:
+                key, shape = specs[idx]
+                if idx in absent:
+                    results[idx] = None
+                    continue
+                if idx not in torn or (final and idx not in odd):
+                    if idx in torn:
+                        logging.warning(
+                            'BGET %s: version kept advancing for %d '
+                            'attempts (concurrent single-frame pushes);'
+                            ' returning the last assembly — '
+                            'element-level staleness only, parity was '
+                            'even throughout the final pass',
+                            key, max_attempts)
+                    arr = parts[idx][0] if len(parts[idx]) == 1 else \
+                        np.concatenate(parts[idx])
+                    if shape is not None:
+                        arr = arr.reshape(shape)
+                    results[idx] = arr.astype(dtype, copy=False)
+                    continue
+                ver = cur_ver.get(idx)
+                if ver != last_ver.get(idx):
+                    last_ver[idx] = ver
+                    last_progress[idx] = now
+                elif idx in odd and \
+                        now - last_progress.get(idx, now) > stall_s:
+                    raise OSError(
+                        'BGET %s: a chunked write is stuck mid-flight '
+                        '(version parity odd and not advancing for '
+                        '%.0fs) — a peer likely died mid-push'
+                        % (key, stall_s))
+                retry.append(idx)
+            pending = retry
+            if not pending:
+                return results
             # linear backoff from the configured base, capped at the
             # larger of 0.2s and one base interval (a base above 0.2
             # must not be silently clamped back to the old cap)
@@ -473,7 +614,8 @@ class CoordClient:
         raise OSError(
             'BGET %s: a chunked write was still mid-flight (version '
             'parity odd) after %d attempts — raising rather than '
-            'returning a half-applied tensor' % (key, max_attempts))
+            'returning a half-applied tensor'
+            % (specs[pending[0]][0], max_attempts))
 
     def vadd(self, key, delta, wire=None):
         """Atomically add a delta elementwise (apply-per-push, the
@@ -481,20 +623,38 @@ class CoordClient:
         ps_synchronizer.py:556-633 with num_required=1). Returns the
         tensor's total push count. Addition commutes, so chunked pushes
         from concurrent workers interleave exactly."""
+        return self.vmadd([(key, delta)], wire=wire)[key]
+
+    def vmadd(self, items, wire=None):
+        """Pipelined multi-tensor :meth:`vadd`: every (key, delta) in
+        ``items`` is accumulated with vadd's exact chunking, all request
+        frames written ahead of draining the replies. Returns
+        ``{key: push count}``."""
         wire = _wire_dtype(wire)
-        flat = np.ascontiguousarray(
-            np.asarray(delta, dtype=np.float32)).reshape(-1)
-        ranges = self._ranges(flat.size, wire)
-        pushes = 0
-        for off, count in ranges:
-            payload = _encode(flat[off:off + count], wire)
-            suffix = '' if len(ranges) == 1 else \
-                ' %d %d' % (off, flat.size)
-            resp = self._rpc('BADD %s %d %s%s'
-                             % (key, len(payload), wire, suffix), payload)
+        frames = []
+        for key, delta in items:
+            flat = _as_f32_flat(delta)
+            ranges = self._ranges(flat.size, wire)
+            for off, count in ranges:
+                payload = _encode(flat[off:off + count], wire)
+                suffix = '' if len(ranges) == 1 else \
+                    ' %d %d' % (off, flat.size)
+                frames.append((key, 'BADD %s %d %s%s'
+                               % (key, len(payload), wire, suffix),
+                               payload))
+        pushes = {}
+        errs = []
+
+        def reply(key):
+            resp = self._read_reply_line()
             if not resp.startswith('VAL'):
-                raise OSError('BADD %s failed: %s' % (key, resp))
-            pushes = int(resp[4:])
+                errs.append('BADD %s failed: %s' % (key, resp))
+                return
+            pushes[key] = int(resp[4:])
+
+        self._pipelined(frames, reply)
+        if errs:
+            raise OSError('; '.join(errs))
         return pushes
 
     def vstep(self, key, grad, rule, params, wire=None):
@@ -510,8 +670,7 @@ class CoordClient:
         pass it explicitly — every rule is elementwise in (w, slots), so
         ranged application is exact."""
         wire = _wire_dtype(wire)
-        flat = np.ascontiguousarray(
-            np.asarray(grad, dtype=np.float32)).reshape(-1)
+        flat = _as_f32_flat(grad)
         p = (list(params) + [0.0] * 4)[:4]
         ranges = self._ranges(flat.size, wire)
         step = 0
@@ -629,3 +788,159 @@ class CoordClient:
             if now - last[1] > timeout_s:
                 dead.append(w)
         return dead
+
+
+class TransferJob:
+    """Future-like handle for one :class:`TransferPool` job."""
+
+    def __init__(self, fn, endpoint):
+        self.fn = fn
+        self.endpoint = endpoint
+        self._done = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def set_result(self, value):
+        self._value = value
+        self._done.set()
+
+    def set_error(self, exc):
+        self._exc = exc
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Join the job; re-raises the job's exception if it failed."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                'PS transfer on endpoint %d did not finish within %ss'
+                % (self.endpoint, timeout))
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class TransferPool:
+    """Persistent per-endpoint transfer workers for the loose-mode PS
+    data plane.
+
+    One daemon thread per endpoint, each owning its OWN connection
+    (CoordClient sockets are not thread-safe, and a dedicated
+    connection keeps the session's control-plane client free for
+    gates/heartbeats while transfers run in the background). Jobs
+    submitted to one endpoint run strictly in FIFO order — which is
+    what makes a pull queued behind the same variable's push
+    read-your-writes safe for free — while distinct endpoints run
+    concurrently, like the reference's concurrent grpc channels.
+    Replaces the per-call ``threading.Thread`` spawn the session used
+    to pay on every pull/push.
+
+    Workers connect lazily on their first job and reconnect on the
+    next job after a connection-level failure (the failed job carries
+    the error to its joiner).
+    """
+
+    def __init__(self, connects):
+        """``connects``: one zero-arg client factory per endpoint."""
+        self._connects = list(connects)
+        self._queues = [queue.Queue() for _ in self._connects]
+        self._threads = [None] * len(self._connects)
+        self._closed = False
+
+    def __len__(self):
+        return len(self._connects)
+
+    def _worker(self, ep):
+        q = self._queues[ep]
+        client = None
+        while True:
+            job = q.get()
+            if job is None:
+                break
+            try:
+                if client is None:
+                    client = self._connects[ep]()
+                job.set_result(job.fn(client))
+            except BaseException as e:  # noqa: BLE001 - carried to joiner
+                if isinstance(e, OSError) and client is not None:
+                    # connection-level failure: drop the socket so the
+                    # next job reconnects instead of reusing a dead or
+                    # unframed stream
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+                    client = None
+                job.set_error(e)
+        if client is not None:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def submit(self, ep, fn):
+        """Queue ``fn(client)`` on endpoint ``ep``'s worker; returns a
+        :class:`TransferJob` to join."""
+        if self._closed:
+            # the workers have drained their sentinels and exited; a
+            # queued job would never run and its joiner would hang
+            raise OSError('TransferPool is closed')
+        if self._threads[ep] is None:
+            t = threading.Thread(target=self._worker, args=(ep,),
+                                 daemon=True,
+                                 name='autodist-ps-xfer-%d' % ep)
+            self._threads[ep] = t
+            t.start()
+        job = TransferJob(fn, ep)
+        self._queues[ep].put(job)
+        return job
+
+    def run(self, jobs):
+        """Submit ``[(endpoint, fn)]`` and join them all.
+
+        Every failure is logged WITH its endpoint before anything is
+        raised; a single failure re-raises as itself (type-preserving
+        for callers matching OSError), several raise one aggregate
+        RuntimeError naming every endpoint — no endpoint's error is
+        silently dropped. Returns the per-job results in order."""
+        handles = [self.submit(ep, fn) for ep, fn in jobs]
+        results = []
+        errs = []
+        for h in handles:
+            try:
+                results.append(h.result())
+            # BaseException too (workers capture it): SystemExit from a
+            # job must not unwind this loop before every handle is
+            # joined and logged — that would drop the others' errors
+            except BaseException as e:  # noqa: BLE001 - aggregated below
+                logging.error('PS transfer failed on endpoint %d: %s: %s',
+                              h.endpoint, type(e).__name__, e)
+                errs.append((h.endpoint, e))
+        # a non-Exception (KeyboardInterrupt/SystemExit) outranks any
+        # aggregate: re-raise it as itself once everything is joined
+        for _, e in errs:
+            if not isinstance(e, Exception):
+                raise e
+        if len(errs) == 1:
+            raise errs[0][1]
+        if errs:
+            raise RuntimeError(
+                'PS transfer failed on %d endpoints: %s'
+                % (len(errs),
+                   '; '.join('endpoint %d: %s: %s'
+                             % (ep, type(e).__name__, e)
+                             for ep, e in errs)))
+        return results
+
+    def close(self, timeout=15.0):
+        """Stop every worker (drains each queue first) and close their
+        connections. Subsequent :meth:`submit` raises OSError."""
+        self._closed = True
+        for q, t in zip(self._queues, self._threads):
+            if t is not None:
+                q.put(None)
+        for t in self._threads:
+            if t is not None:
+                t.join(timeout=timeout)

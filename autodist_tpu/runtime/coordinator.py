@@ -33,6 +33,10 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_BUCKET_BYTES, ENV.AUTODIST_XLA_OVERLAP,
                     ENV.AUTODIST_PS_TORN_RETRIES,
                     ENV.AUTODIST_PS_TORN_BACKOFF_S,
+                    # async PS data-plane knobs: every loose-mode worker
+                    # must agree on the pipeline depth and stall window
+                    ENV.AUTODIST_PS_PIPELINE_DEPTH,
+                    ENV.AUTODIST_PS_STALL_TIMEOUT_S,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 # AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
 # assignments ride the remote ssh command line, which is world-readable

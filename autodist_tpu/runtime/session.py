@@ -166,15 +166,48 @@ class Session:
                                for s in p.all_syncs)}
         self._shared_warned = set()
         self._shared_pushes = 0
-        # loose-mode PS data plane: one client per endpoint, variables
-        # placed by reduction_destination (multi-server PS)
-        self._ps_clients = []
+        # loose-mode PS data plane: a persistent TransferPool worker
+        # (own connection) per endpoint, variables placed by
+        # reduction_destination (multi-server PS)
+        self._pool = None
+        self._ps_addrs = []
         self._ps_index = {}
         self._ps_bytes = 0
         self._ps_ep_bytes = []
         self._ps_seconds = 0.0
+        # async pipeline (AUTODIST_PS_PIPELINE_DEPTH >= 2): step N's
+        # delta push + publish and step N+1's variable pull run on a
+        # dedicated background thread; run() only joins the result.
+        # _stats_lock guards the wire accounting those threads share
+        # with the main thread.
+        import threading
+        self._stats_lock = threading.Lock()
+        self._pipe = None
+        self._inflight = None
+        self._stashed_prefetch = None
+        self._pipeline_depth = 1
+        self._ps_phase = {'pull_s': 0.0, 'push_s': 0.0, 'step_s': 0.0,
+                          'exposed_wait_s': 0.0, 'train_steps': 0,
+                          'discarded_prefetches': 0}
         if self._loose:
             self._init_ps_endpoints()
+            depth = ENV.AUTODIST_PS_PIPELINE_DEPTH.val
+            if depth > 2:
+                logging.warning(
+                    'AUTODIST_PS_PIPELINE_DEPTH=%d clamps to 2: a pull '
+                    'must follow the previous push of the same variable '
+                    '(read-your-writes), so at most one step can be in '
+                    'flight', depth)
+                depth = 2
+            self._pipeline_depth = depth
+            if depth > 1:
+                from autodist_tpu.runtime import coord_client as cc
+                coord_addr = getattr(self._coord, 'address', None)
+                # the pipeline thread publishes steps through its OWN
+                # control-plane connection (CoordClient sockets are not
+                # thread-safe; the main thread keeps using self._coord)
+                self._pipe = cc.TransferPool(
+                    [lambda: cc.connect_with_retry(coord_addr)])
         if self._proxy_vars and not self._loose:
             logging.info(
                 'local_proxy_variable on %d vars: subsumed by SPMD '
@@ -341,36 +374,46 @@ class Session:
 
     # -- loose-mode PS endpoint placement ----------------------------------
     def _init_ps_endpoints(self):
-        """Connect the PS data plane. With ``AUTODIST_PS_ENDPOINTS`` set,
-        each variable is served by the endpoint its strategy
-        ``reduction_destination`` maps to — host match first (endpoints
-        co-located with PS nodes), else the destination's ordinal among
-        the distinct destinations — so PSLoadBalancing's byte-size
-        bin-packing (reference ps_lb_strategy.py:64-83) decides real
-        runtime placement, like the reference's one tf.Server per PS node
-        (utils/server_starter.py:48-75). Without endpoints, all variables
-        live on the coord service (single-PS layout)."""
+        """Bring up the PS data plane: a persistent
+        :class:`~autodist_tpu.runtime.coord_client.TransferPool` worker
+        (own connection, lazily dialed) per endpoint. With
+        ``AUTODIST_PS_ENDPOINTS`` set, each variable is served by the
+        endpoint its strategy ``reduction_destination`` maps to — host
+        match first (endpoints co-located with PS nodes), else the
+        destination's ordinal among the distinct destinations — so
+        PSLoadBalancing's byte-size bin-packing (reference
+        ps_lb_strategy.py:64-83) decides real runtime placement, like
+        the reference's one tf.Server per PS node
+        (utils/server_starter.py:48-75). Without endpoints, all
+        variables live on the coord service (single-PS layout; the pool
+        worker dials its own connection so background transfers never
+        contend with the main thread's control-plane client)."""
         from autodist_tpu.runtime import coord_client as cc
         from autodist_tpu.runtime.cluster import is_local_address
         eps = cc.ps_endpoints()
-        if not eps:
-            self._ps_clients = [self._coord]
-            return
-        # a locally-hosted endpoint may be bound to loopback (all-local
-        # runs); dialing 127.0.0.1 works under either bind, while the
-        # raw NIC address fails against a loopback bind — same rewrite
-        # the coord-service connection applies (autodist.py)
-        self._ps_clients = [
-            cc.connect_with_retry(
-                ('127.0.0.1' if is_local_address(host) else host, port))
-            for host, port in eps]
-        self._ps_index = assign_ps_endpoints(self._plan.var_plans, eps)
-        counts = [0] * len(eps)
-        for idxs in self._ps_index.values():
-            for i in idxs:
-                counts[i] += 1
-        logging.info('PS data plane: %d endpoints, variable shards per '
-                     'endpoint %s', len(eps), counts)
+        if eps:
+            # a locally-hosted endpoint may be bound to loopback
+            # (all-local runs); dialing 127.0.0.1 works under either
+            # bind, while the raw NIC address fails against a loopback
+            # bind — same rewrite the coord-service connection applies
+            # (autodist.py)
+            self._ps_addrs = [
+                ('127.0.0.1' if is_local_address(host) else host, port)
+                for host, port in eps]
+            self._ps_index = assign_ps_endpoints(self._plan.var_plans,
+                                                 eps)
+            counts = [0] * len(eps)
+            for idxs in self._ps_index.values():
+                for i in idxs:
+                    counts[i] += 1
+            logging.info('PS data plane: %d endpoints, variable shards '
+                         'per endpoint %s', len(eps), counts)
+        else:
+            self._ps_addrs = [tuple(getattr(self._coord, 'address',
+                                            (None, 0)))]
+        self._pool = cc.TransferPool(
+            [lambda addr=addr: cc.connect_with_retry(addr)
+             for addr in self._ps_addrs])
 
     @staticmethod
     def _stable_idx(name, n):
@@ -397,20 +440,16 @@ class Session:
         fewer destinations than shards)."""
         idxs = self._ps_index.get(name)
         if idxs is None:
-            idxs = [self._stable_idx(name, len(self._ps_clients))]
+            idxs = [self._stable_idx(name, len(self._ps_addrs))]
             self._ps_index[name] = idxs
         if len(idxs) < nshards:
             idxs = [idxs[i % len(idxs)] for i in range(nshards)]
         return idxs
 
-    def _ps_transfer(self, names, fn):
-        """Run ``fn(client, key_suffix, name, shard_i, part_config)``
-        for every (variable, shard) transfer unit; units grouped by
-        endpoint, endpoint groups in parallel threads. Each endpoint's
-        socket is used by exactly one thread (CoordClient sockets are
-        not thread-safe), so multi-endpoint pulls/pushes overlap across
-        PS servers like the reference's concurrent grpc channels.
-        Returns ``{name: [per-shard result]}``."""
+    def _transfer_groups(self, names):
+        """Group every (variable, shard) transfer unit by the endpoint
+        it lives on: ``{endpoint: [(key_suffix, name, shard_i,
+        part_config)]}`` plus the per-name shard counts."""
         groups = {}
         shard_counts = {}
         for name in names:
@@ -419,41 +458,15 @@ class Session:
             shard_counts[name] = len(keys)
             for i, (key, ep) in enumerate(zip(keys, idxs)):
                 groups.setdefault(ep, []).append((key, name, i, pc))
-        results = {name: [None] * c for name, c in shard_counts.items()}
-
-        def run_group(ep, units):
-            client = self._ps_clients[ep]
-            for key, name, i, pc in units:
-                results[name][i] = fn(client, key, name, i, pc)
-
-        if len(groups) <= 1:
-            for ep, units in groups.items():
-                run_group(ep, units)
-            return results
-        import threading
-        errs = []
-
-        def work(ep, units):
-            try:
-                run_group(ep, units)
-            except Exception as e:  # noqa: BLE001 - re-raised below
-                errs.append(e)
-
-        threads = [threading.Thread(target=work, args=(ep, units))
-                   for ep, units in groups.items()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errs:
-            raise errs[0]
-        return results
+        return groups, shard_counts
 
     def _account_ep_bytes(self, name):
         """Attribute one whole-tensor transfer's wire bytes to the
-        endpoints its shards live on (per-endpoint load accounting)."""
+        endpoints its shards live on (per-endpoint load accounting).
+        Caller must hold ``_stats_lock`` (pipeline threads and the main
+        thread both account)."""
         if not self._ps_ep_bytes:
-            self._ps_ep_bytes = [0] * len(self._ps_clients)
+            self._ps_ep_bytes = [0] * len(self._ps_addrs)
         var = self._graph_item.var_by_name(name)
         pc, keys = self._shard_info(name)
         idxs = self._shard_endpoints(name, len(keys))
@@ -469,11 +482,33 @@ class Session:
     def ps_stats(self):
         """Loose-mode wire accounting: payload bytes moved and seconds
         spent on PS pulls+pushes (the measured per-step PS overhead),
-        plus the per-endpoint byte split (balanced placement evidence)."""
-        return {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
-                'bytes_per_endpoint': list(self._ps_ep_bytes),
-                'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
-                             if self._ps_seconds else 0.0)}
+        plus the per-endpoint byte split (balanced placement evidence)
+        and the async-pipeline phase breakdown — per-train-step pull /
+        step / push seconds, the wire seconds actually EXPOSED on the
+        critical path, and ``overlap_frac`` = the fraction of wire time
+        the pipeline hid behind compute and host tail (0 at depth 1 by
+        construction)."""
+        with self._stats_lock:
+            ph = dict(self._ps_phase)
+            out = {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
+                   'bytes_per_endpoint': list(self._ps_ep_bytes),
+                   'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
+                                if self._ps_seconds else 0.0)}
+        steps = max(1, ph['train_steps'])
+        wire = ph['pull_s'] + ph['push_s']
+        out['pipeline'] = {
+            'depth': self._pipeline_depth,
+            'train_steps': ph['train_steps'],
+            'discarded_prefetches': ph['discarded_prefetches'],
+            'pull_s': ph['pull_s'] / steps,
+            'step_s': ph['step_s'] / steps,
+            'push_s': ph['push_s'] / steps,
+            'exposed_wait_s': ph['exposed_wait_s'] / steps,
+            'overlap_frac': max(0.0, min(1.0, 1.0 -
+                                ph['exposed_wait_s'] / wire))
+            if wire > 0 else 0.0,
+        }
+        return out
 
     # -- multi-process placement helpers ----------------------------------
     def _put(self, value, sharding):
@@ -528,28 +563,21 @@ class Session:
         if self._loose:
             variables = self._graph_item.graph.variables
 
-            def seed(c, key, name, shard, pc):
-                val = np.asarray(variables[name].init_value)
-                if pc is not None:
-                    val = pc.split(val)[shard]
-                c.vset(self._key(key), val)
-
-            def fetch(c, key, name, shard, pc):
-                shp = variables[name].shape if pc is None else \
-                    pc.shard_shapes(variables[name].shape)[shard]
-                return c.vget(self._key(key), shape=shp)
-
             # chief seeds the authoritative PS copies across endpoints,
-            # one tensor per shard for partitioned variables
+            # one tensor per shard for partitioned variables — one
+            # pipelined vmset batch per endpoint (one round trip each
+            # instead of one per variable/shard/chunk)
             if self._is_chief:
-                self._ps_transfer(list(variables), seed)
+                self._store_var_parts(
+                    {name: v.init_value
+                     for name, v in variables.items()})
             # heartbeat baseline BEFORE the barrier: once any gate runs,
             # every peer has a timestamp (a missing one reads as dead)
             self._coord.heartbeat(self._key(self._worker_name))
             self._coord.barrier(self._key('session/init'),
                                 self._num_workers, timeout_s=120.0)
             if not self._is_chief:
-                served_map = self._ps_transfer(list(variables), fetch)
+                served_map, _ = self._fetch_var_parts(list(variables))
                 for name, parts in served_map.items():
                     var = variables[name]
                     pc, _ = self._shard_info(name)
@@ -670,6 +698,12 @@ class Session:
 
         pulled = None
         if self._loose:
+            # join any in-flight background push FIRST (pipeline depth
+            # >= 2): its error surfaces here instead of silently, and
+            # the pull below must observe our own landed pushes
+            # (read-your-writes) — the prefetch record it returns was
+            # only issued after the push completed.
+            prefetch = self._join_pipeline()
             # bounded-staleness window (reference token queues of size s,
             # ps_synchronizer.py:387-458): before running step s (1-based)
             # every worker must have completed >= s - staleness steps.
@@ -681,7 +715,17 @@ class Session:
                     self._step_count + 1, self._plan.gate_staleness,
                     self._num_workers, prefix=self._key('step/'),
                     failure_check=self._check_peers_alive)
-            pulled = self._pull_ps_vars()
+                # the gate guarantees every peer completed >= step -
+                # staleness; a prefetch taken while some peer was still
+                # below that bound may lack pushes the gate just
+                # guaranteed — discard it (the refetch pays the exposed
+                # wire time serial mode would have paid anyway)
+                if prefetch is not None and prefetch.get(
+                        'peer_floor', -1) < \
+                        self._step_count + 1 - self._plan.gate_staleness:
+                    self._account_prefetch_discard(prefetch)
+                    prefetch = None
+            pulled = self._pull_ps_vars(prefetch, train=is_train)
 
         placed = []
         for v, split in zip(feed_vals, split_flags):
@@ -701,6 +745,8 @@ class Session:
         if tracing:
             os.makedirs(options.trace_dir, exist_ok=True)
             jax.profiler.start_trace(options.trace_dir)
+        import time as _time
+        t_step = _time.perf_counter()
         try:
             outs, self._var_state, self._opt_state, self._aux_state = fn(
                 self._var_state, self._opt_state, self._aux_state, placed)
@@ -714,15 +760,11 @@ class Session:
         if is_train:
             self._step_count += 1
             if self._loose:
-                shared_push = {}
-                for name, idx, rule, params in shared_spec:
-                    g = self._local_stack(outs[idx])[0]
-                    shared_push[name] = (np.asarray(g, np.float32),
-                                         rule, params)
-                self._push_ps_deltas(pulled, shared_push)
-                self._coord.publish_step(self._worker_name,
-                                         self._step_count,
-                                         prefix=self._key('step/'))
+                with self._stats_lock:
+                    self._ps_phase['step_s'] += \
+                        _time.perf_counter() - t_step
+                    self._ps_phase['train_steps'] += 1
+                self._dispatch_push(shared_spec, outs, pulled)
 
         split_sizes = {v.shape[0] // self._plan.local_replicas
                        for v, s in zip(feed_vals, split_flags) if s}
@@ -735,26 +777,214 @@ class Session:
         from autodist_tpu.runtime.coord_client import _wire_dtype
         return n_elems * (2 if _wire_dtype() == 'bf16' else 4)
 
-    def _pull_ps_vars(self):
-        """Refresh variable state from the authoritative PS copies (the
-        worker's per-step PS read), endpoints pulled in parallel; each
-        shard of a partitioned variable comes from its own endpoint.
-        Returns the pulled host values for delta computation."""
+    def _join_pipeline(self):
+        """Join the in-flight background push job (pipeline depth >= 2)
+        and return its prefetch record (None when nothing is in
+        flight). Any error the pipeline hit — push, publish, or
+        pull-ahead — re-raises HERE, on the caller's thread, so a
+        failed background push can never be silently lost. The wall
+        time spent blocked is the wire time the pipeline failed to
+        hide; it feeds ``overlap_frac``."""
+        job = self._inflight
+        if job is None:
+            # a read-only access (get_variable_value) may have joined
+            # the job early and stashed its still-valid prefetch
+            stash, self._stashed_prefetch = self._stashed_prefetch, None
+            return stash
+        self._inflight = None
         import time as _time
         t0 = _time.perf_counter()
+        try:
+            return job.result()
+        finally:
+            with self._stats_lock:
+                self._ps_phase['exposed_wait_s'] += \
+                    _time.perf_counter() - t0
+
+    def _drain_pipeline(self, keep_prefetch=False):
+        """Join any in-flight pipeline work: user-facing reads/writes
+        (checkpointing, variable loads) must see their own session's
+        pushes. With ``keep_prefetch`` (read-only callers — a read does
+        not invalidate the prefetched pull) the record is stashed for
+        the next ``run()`` instead of discarded, so per-step variable
+        reads don't silently degrade depth 2 to serial pulls; a load
+        supersedes the prefetch and discards it (the dropped record's
+        wire traffic still counts — it moved)."""
+        record = self._join_pipeline()
+        if record is not None and not keep_prefetch:
+            self._account_prefetch_discard(record)
+            record = None
+        self._stashed_prefetch = record if keep_prefetch else None
+
+    def _dispatch_push(self, shared_spec, outs, pulled):
+        """Ship the just-completed step's updates.
+
+        Depth 1: serial push + publish on the calling thread — the
+        bit-exact legacy data plane. Depth >= 2: the device->host
+        readback of gradients/updated state, the delta push, the step
+        publish and the NEXT step's variable pull-ahead all run on the
+        single-threaded pipeline worker; ``run()`` joins the result at
+        the next step's entry, so the wire time hides behind this
+        step's host tail and the inter-step interval.
+
+        Ordering invariants, both depths: push -> publish (the
+        staleness gate must only count a step whose update landed) and
+        push -> next pull (per-variable read-your-writes; the pipeline
+        issues the pull-ahead strictly after every endpoint's push
+        join). run() joins the pipeline BEFORE gating, so our own
+        published counter is always current at the gate, and it
+        discards a prefetch whose recorded peer floor is below the next
+        step's staleness bound — the pipeline adds overlap inside the
+        existing staleness bound, never extra staleness."""
+        step = self._step_count
+        worker = self._worker_name
+        prefix = self._key('step/')
+
+        def shared_values():
+            out = {}
+            for name, idx, rule, params in shared_spec:
+                g = self._local_stack(outs[idx])[0]
+                out[name] = (np.asarray(g, np.float32), rule, params)
+            return out
+
+        if self._pipe is None:
+            import time as _time
+            t0 = _time.perf_counter()
+            self._push_ps_deltas(pulled, shared_values())
+            self._coord.publish_step(worker, step, prefix=prefix)
+            with self._stats_lock:
+                self._ps_phase['exposed_wait_s'] += \
+                    _time.perf_counter() - t0
+            return
+
+        num_workers = self._num_workers
+
+        def job(client):
+            self._push_ps_deltas(pulled, shared_values())
+            client.publish_step(worker, step, prefix=prefix)
+            # lower-bound what the pull-ahead below will observe: a
+            # peer's published counter only advances AFTER its push
+            # landed (push -> publish), so every push published by now
+            # is visible to the pull. run() compares this floor against
+            # the next step's staleness bound and discards the prefetch
+            # if it was taken too early — the pipeline must never serve
+            # values staler than the gate guarantees.
+            floor = step if num_workers <= 1 else min(
+                client.incr(prefix + 'p%d' % i, 0)
+                for i in range(num_workers))
+            to_fetch = self._pull_to_fetch()
+            parts, wire_s = self._fetch_var_parts(to_fetch)
+            return {'names': to_fetch, 'parts': parts,
+                    'wire_s': wire_s, 'peer_floor': floor}
+
+        self._inflight = self._pipe.submit(0, job)
+
+    def _pull_to_fetch(self):
+        """The variables a per-step pull must actually fetch (proxy
+        variables with a warm cache are served locally)."""
+        return [name for name in self._graph_item.graph.variables
+                if not (name in self._proxy_vars and
+                        name in self._proxy_cache)]
+
+    def _fetch_var_parts(self, names):
+        """Batched authoritative fetch: ONE pipelined ``vmget`` per
+        endpoint covers every (variable, shard) unit it serves — all
+        request frames on the wire before the first reply is drained,
+        endpoints in parallel on the TransferPool workers. Returns
+        ``({name: [per-shard host array]}, wall seconds)``."""
+        import time as _time
         variables = self._graph_item.graph.variables
-        to_fetch = [name for name in variables
-                    if not (name in self._proxy_vars and
-                            name in self._proxy_cache)]
+        groups, shard_counts = self._transfer_groups(names)
+        results = {name: [None] * c for name, c in shard_counts.items()}
+        t0 = _time.perf_counter()
 
-        def fetch(c, key, name, shard, pc):
-            shp = variables[name].shape if pc is None else \
-                pc.shard_shapes(variables[name].shape)[shard]
-            return c.vget(self._key(key), shape=shp)
+        def fetch_group(units):
+            def go(client):
+                specs = []
+                for key, name, i, pc in units:
+                    shp = variables[name].shape if pc is None else \
+                        pc.shard_shapes(variables[name].shape)[i]
+                    specs.append((self._key(key), shp))
+                arrs = client.vmget(specs)
+                return [(name, i, a) for (_, name, i, _), a
+                        in zip(units, arrs)]
+            return go
 
-        fetched = self._ps_transfer(to_fetch, fetch)
+        for got in self._pool.run([(ep, fetch_group(units))
+                                   for ep, units in groups.items()]):
+            for name, i, a in got:
+                results[name][i] = a
+        return results, _time.perf_counter() - t0
+
+    def _store_var_parts(self, values):
+        """Batched authoritative store, `_fetch_var_parts`'s write twin:
+        ONE pipelined ``vmset`` per endpoint covers every (variable,
+        shard) unit in ``values`` (``{name: whole host value}``; shards
+        are split here)."""
+        groups, _ = self._transfer_groups(list(values))
+
+        def store_group(units):
+            def go(client):
+                items = []
+                for key, name, i, pc in units:
+                    val = np.asarray(values[name])
+                    if pc is not None:
+                        val = pc.split(val)[i]
+                    items.append((self._key(key), val))
+                client.vmset(items)
+            return go
+
+        self._pool.run([(ep, store_group(units))
+                        for ep, units in groups.items()])
+
+    def _account_prefetch_discard(self, prefetch):
+        """A discarded pull-ahead still moved its whole payload on the
+        wire — account that traffic (bytes, seconds, per-endpoint
+        split) so ``ps_stats`` reflects what the network actually
+        carried, and count the discard so the pipeline block shows how
+        often the peer-floor check fell back to an exposed refetch.
+        The wasted wire seconds deliberately do NOT join the per-step
+        ``pull_s`` phase: overlap_frac must not improve because hidden
+        wire time was thrown away."""
+        n_elems = 0
+        for name in prefetch['names']:
+            var = self._graph_item.var_by_name(name)
+            n_elems += int(np.prod(var.shape)) if var.shape else 1
+        with self._stats_lock:
+            for name in prefetch['names']:
+                self._account_ep_bytes(name)
+            self._ps_seconds += prefetch['wire_s']
+            self._ps_bytes += self._wire_nbytes(n_elems)
+            self._ps_phase['discarded_prefetches'] += 1
+
+    def _pull_ps_vars(self, prefetch=None, train=True):
+        """Refresh variable state from the authoritative PS copies (the
+        worker's per-step PS read); each shard of a partitioned
+        variable comes from its own endpoint. With ``prefetch`` (the
+        pipeline's pull-ahead record, depth >= 2) the host values were
+        already fetched in the background and only device placement
+        remains on the critical path. Returns the pulled host values
+        for delta computation. Fetch-only runs (``train=False``) keep
+        the global wire accounting but stay out of the per-train-step
+        phase averages ``ps_stats['pipeline']`` divides by
+        ``train_steps``."""
+        import time as _time
+        variables = self._graph_item.graph.variables
+        to_fetch = self._pull_to_fetch()
+        fetched = None
+        wire_s = exposed_s = 0.0
+        if prefetch is not None and prefetch['names'] == to_fetch:
+            fetched = prefetch['parts']
+            wire_s = prefetch['wire_s']
+        if fetched is None:
+            # no (usable) prefetch: the fetch is fully exposed
+            fetched, wire_s = self._fetch_var_parts(to_fetch)
+            exposed_s = wire_s
         pulled = {}
         n_elems = 0
+        with self._stats_lock:
+            for name in fetched:
+                self._account_ep_bytes(name)
         for name, var in variables.items():
             if name in fetched:
                 parts = fetched[name]
@@ -763,7 +993,6 @@ class Session:
                     None if any(p is None for p in parts)
                     else pc.merge(parts))
                 n_elems += int(np.prod(var.shape)) if var.shape else 1
-                self._account_ep_bytes(name)
                 if served is None:  # pragma: no cover - init barrier
                     served = np.asarray(var.init_value, dtype=np.float32)
                 served = served.astype(var.init_value.dtype)
@@ -776,8 +1005,12 @@ class Session:
             self._var_state[name] = self._put(
                 self._plan.pad_host(name, jnp.asarray(served)),
                 self._plan.var_sharding(name))
-        self._ps_seconds += _time.perf_counter() - t0
-        self._ps_bytes += self._wire_nbytes(n_elems)
+        with self._stats_lock:
+            self._ps_seconds += wire_s
+            self._ps_bytes += self._wire_nbytes(n_elems)
+            if train:
+                self._ps_phase['pull_s'] += wire_s
+                self._ps_phase['exposed_wait_s'] += exposed_s
         return pulled
 
     def _shared_push_spec(self, norm):
@@ -824,55 +1057,70 @@ class Session:
         PS-resident shared slots (BSTEP). Partitioned variables push
         each shard's slice to that shard's own endpoint (the reference
         splits gradients per shard, kernel/partitioner.py:686-704).
-        Endpoint groups push in parallel."""
+        Endpoint groups push in parallel on the TransferPool workers,
+        each as ONE pipelined ``vmadd`` batch (plus serial ``vstep``
+        for shared-optimizer vars — the chunk-shared step index makes
+        those inherently sequential). At pipeline depth >= 2 this whole
+        method runs on the background pipeline thread, including the
+        device->host readback of the updated state."""
         import time as _time
         t0 = _time.perf_counter()
         shared_push = shared_push or {}
         afters = {name: np.asarray(self._local_value(name),
                                    dtype=np.float32)
                   for name in pulled if name not in shared_push}
+        deltas = {name: after - np.asarray(pulled[name],
+                                           dtype=np.float32)
+                  for name, after in afters.items()}
+        groups, _ = self._transfer_groups(list(pulled))
 
-        def push(client, key, name, shard, pc):
-            if name in shared_push:
-                g, rule, params = shared_push[name]
-                if pc is not None:
-                    g = pc.split(g)[shard]
-                client.vstep(self._key(key), g, rule, params)
-            else:
-                delta = afters[name] - np.asarray(pulled[name],
-                                                  dtype=np.float32)
-                if pc is not None:
-                    delta = pc.split(delta)[shard]
-                client.vadd(self._key(key), delta)
+        def push_group(units):
+            def go(client):
+                adds = []
+                for key, name, i, pc in units:
+                    if name in shared_push:
+                        g, rule, params = shared_push[name]
+                        if pc is not None:
+                            g = pc.split(g)[i]
+                        client.vstep(self._key(key), g, rule, params)
+                    else:
+                        delta = deltas[name]
+                        if pc is not None:
+                            delta = pc.split(delta)[i]
+                        adds.append((self._key(key), delta))
+                if adds:
+                    client.vmadd(adds)
+            return go
 
-        self._ps_transfer(list(pulled), push)
-        for name in pulled:
-            self._account_ep_bytes(name)
+        self._pool.run([(ep, push_group(units))
+                        for ep, units in groups.items()])
+        with self._stats_lock:
+            for name in pulled:
+                self._account_ep_bytes(name)
         self._shared_pushes += sum(1 for n in pulled if n in shared_push)
         n_elems = sum(a.size for a in afters.values()) + \
             sum(g.size for g, _, _ in shared_push.values())
 
-        def refetch(client, key, name, shard, pc):
-            shp = self._graph_item.var_by_name(name).shape
-            if pc is not None:
-                shp = pc.shard_shapes(shp)[shard]
-            return client.vget(self._key(key), shape=shp)
-
         # post-update assign (proxy_variable.py:163-190): refresh the
         # proxy from the PS after the push, off the pre-step path
-        refreshed = self._ps_transfer(list(self._proxy_vars), refetch)
-        for name, parts in refreshed.items():
-            pc, _ = self._shard_info(name)
-            served = parts[0] if pc is None else (
-                None if any(p is None for p in parts)
-                else pc.merge(parts))
-            if served is not None:
-                var = self._graph_item.var_by_name(name)
-                self._proxy_cache[name] = \
-                    served.astype(var.init_value.dtype)
-                n_elems += served.size
-        self._ps_seconds += _time.perf_counter() - t0
-        self._ps_bytes += self._wire_nbytes(n_elems)
+        if self._proxy_vars:
+            refreshed, _ = self._fetch_var_parts(list(self._proxy_vars))
+            for name, parts in refreshed.items():
+                pc, _ = self._shard_info(name)
+                served = parts[0] if pc is None else (
+                    None if any(p is None for p in parts)
+                    else pc.merge(parts))
+                if served is not None:
+                    var = self._graph_item.var_by_name(name)
+                    self._proxy_cache[name] = \
+                        served.astype(var.init_value.dtype)
+                    n_elems += served.size
+        push_s = _time.perf_counter() - t0
+        with self._stats_lock:
+            self._ps_seconds += push_s
+            self._ps_bytes += self._wire_nbytes(n_elems)
+            self._ps_phase['push_s'] += push_s
+        return push_s
 
     def _contract(self, fetch, stacked, split_sizes):
         """Apply the reference fetch contract to the per-replica stack."""
@@ -980,7 +1228,21 @@ class Session:
             thread = getattr(self, '_hb_thread', None)
             if thread is not None and thread.is_alive():
                 thread.join(timeout=15.0)
+        drain_err = None
         if not self._closed and self._loose and self._coord is not None:
+            # our last background push must land BEFORE the done
+            # marker / step sentinel (a peer released by the sentinel
+            # must still see our final update). A failed final push is
+            # NOT swallowed with the best-effort bookkeeping below: it
+            # re-raises after peers are released and the pools closed —
+            # the PS copy is missing this worker's last step.
+            try:
+                self._drain_pipeline()
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                drain_err = e
+                logging.error(
+                    'final background PS push failed in close(): %s: %s',
+                    type(e).__name__, e)
             # clean shutdown is not a crash: publish a done marker so
             # peers exclude us from dead-worker checks, and advance our
             # step counter past any reachable gate bound so a peer
@@ -998,12 +1260,16 @@ class Session:
                 # and only after every peer has closed.
                 closed = self._coord.incr(self._key('closed'), 1)
                 if closed >= self._num_workers:
-                    purged = 0
-                    clients = list(self._ps_clients)
-                    if self._coord not in clients:
-                        clients.append(self._coord)
-                    for client in clients:
-                        purged += client.delete_namespace(self._ns + '/')
+                    purged = sum(self._pool.run(
+                        [(ep, lambda c: c.delete_namespace(
+                            self._ns + '/'))
+                         for ep in range(len(self._pool))]))
+                    coord_addr = tuple(getattr(self._coord, 'address',
+                                               ()) or ())
+                    if coord_addr not in [tuple(a)
+                                          for a in self._ps_addrs]:
+                        purged += self._coord.delete_namespace(
+                            self._ns + '/')
                     for prefix in ('hb/%s/' % self._ns,
                                    'done/%s/' % self._ns):
                         self._coord.delete_namespace(prefix)
@@ -1012,12 +1278,12 @@ class Session:
             except Exception:  # noqa: BLE001 - service may be gone
                 pass
         self._closed = True
-        for client in getattr(self, '_ps_clients', []):
-            if client is not self._coord:
-                try:
-                    client.close()
-                except OSError:  # pragma: no cover - socket already gone
-                    pass
+        for pool in (getattr(self, '_pipe', None),
+                     getattr(self, '_pool', None)):
+            if pool is not None:
+                pool.close()
+        if drain_err is not None:
+            raise drain_err
 
     def __enter__(self):
         return self
@@ -1047,16 +1313,14 @@ class Session:
     def get_variable_value(self, var):
         name = var.name if isinstance(var, fe.Variable) else var
         if self._loose:
+            # read-your-writes at the API surface: our own background
+            # push must land before the authoritative read (the
+            # prefetch stays valid — a read pushes nothing)
+            self._drain_pipeline(keep_prefetch=True)
             # authoritative copy lives on the variable's PS endpoint(s):
             # each shard of a partitioned variable on its own endpoint
             var_obj = self._graph_item.var_by_name(name)
-
-            def fetch(c, key, _name, shard, pc):
-                shp = var_obj.shape if pc is None else \
-                    pc.shard_shapes(var_obj.shape)[shard]
-                return c.vget(self._key(key), shape=shp)
-
-            parts = self._ps_transfer([name], fetch)[name]
+            parts = self._fetch_var_parts([name])[0][name]
             pc, _ = self._shard_info(name)
             served = parts[0] if pc is None else pc.merge(parts)
             return served.astype(var_obj.init_value.dtype)
@@ -1064,14 +1328,12 @@ class Session:
 
     def load_variable_value(self, var, value):
         name = var.name if isinstance(var, fe.Variable) else var
+        if self._loose:
+            # the load supersedes both any in-flight push and the
+            # prefetched pull (which would serve pre-load values)
+            self._drain_pipeline()
         self._var_state[name] = self._put(
             self._plan.pad_host(name, jnp.asarray(value)),
             self._plan.var_sharding(name))
         if self._loose and self._is_chief:
-            def store(c, key, _name, shard, pc):
-                val = np.asarray(value)
-                if pc is not None:
-                    val = pc.split(val)[shard]
-                c.vset(self._key(key), val)
-
-            self._ps_transfer([name], store)
+            self._store_var_parts({name: value})

@@ -75,6 +75,13 @@ class CostModelParams:
     beta_dcn_s_per_byte: float = 8e-9         # 0.125 GB/s
     overlap_discount: float = 0.5             # hidden fraction of
     # overlappable grad-bucket time (latency-hiding scheduler)
+    # Async-PS pull-ahead haircut (AUTODIST_PS_PIPELINE_DEPTH >= 2):
+    # the fraction of PS param-phase traffic (the post-update re-gather
+    # / next-step pull) the background pipeline hides behind the host
+    # tail. Default 0 — predictions for the serial depth-1 plane stay
+    # unchanged unless the caller opts in (tools/simulate.py
+    # --ps-overlap, or a calibrated ps_stats overlap_frac).
+    ps_overlap_discount: float = 0.0
     compute_time_s: float = 0.0
     # compressors are not free: the wire cast reads+writes the full
     # tensor at HBM speed on both ends (~800 GB/s, two passes)
@@ -240,11 +247,19 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         if wb < e['bytes']:   # compressor cast: two HBM passes per end
             t += e['bytes'] * params.compress_s_per_byte
         # grad buckets before the last-emitted one overlap backward
-        # compute; ZeRO scatters and param gathers are also pipelined
-        # but conservatively priced in full
+        # compute; ZeRO scatters are conservatively priced in full.
+        # Param-phase traffic (the post-update re-gather — the static
+        # analog of the loose-mode next-step pull) takes the optional
+        # async-PS haircut so AutoStrategy predictions stay honest for
+        # PS strategies once the pipelined data plane hides that wire
+        # time (ps_overlap_discount defaults to 0 = serial plane).
         overlappable = (i in grad_ar and i != last_grad_ar)
-        t_exposed = t * (1.0 - params.overlap_discount) \
-            if overlappable else t
+        if overlappable:
+            t_exposed = t * (1.0 - params.overlap_discount)
+        elif e['phase'] == 'param' and params.ps_overlap_discount:
+            t_exposed = t * (1.0 - params.ps_overlap_discount)
+        else:
+            t_exposed = t
         sync += t
         exposed += t_exposed
         breakdown.append({

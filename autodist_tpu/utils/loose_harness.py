@@ -1,0 +1,53 @@
+"""Single-process loose-mode harness bootstrap.
+
+Loose mode is a multi-process mode; driving its PS data plane from ONE
+process needs a subtle env dance: the strategy build must see 2
+processes (the mode decision) while the session sees 1 (no peers to
+barrier with) — the same data plane either way. bench.py's ps-pipeline
+A/B and tests/test_async_ps.py both ride this helper so the dance
+lives in exactly one place.
+"""
+import os
+from contextlib import contextmanager
+
+_KNOBS = ('AUTODIST_COORD_SERVICE_ADDR', 'AUTODIST_PS_PIPELINE_DEPTH',
+          'AUTODIST_NUM_PROCESSES', 'AUTODIST_PROCESS_ID')
+
+
+@contextmanager
+def single_process_loose_env(coord_port, depth):
+    """Environment bootstrap for a single-process loose-mode run
+    against the coord service on localhost ``coord_port`` at PS
+    pipeline ``depth``.
+
+    Yields a zero-arg callable to invoke AFTER ``autodist._build()``
+    (which must see 2 processes → loose mode) and BEFORE
+    ``create_distributed_session()`` (which must see 1 → no peers to
+    barrier with). Every touched knob is restored on exit, and any
+    process-default AutoDist singleton is cleared so this instance
+    owns the scope.
+    """
+    from autodist_tpu import autodist as ad_mod
+    saved = {k: os.environ.get(k) for k in _KNOBS}
+    ad_mod._DEFAULT_AUTODIST.clear()
+    try:
+        # an earlier AutoDist in this process claimed chief identity via
+        # os.environ.setdefault(AUTODIST_PROCESS_ID, '0'); a leftover
+        # value would make THIS instance look externally-launched and
+        # join a 2-party ctrl/init barrier nobody else attends
+        os.environ.pop('AUTODIST_PROCESS_ID', None)
+        os.environ['AUTODIST_COORD_SERVICE_ADDR'] = \
+            '127.0.0.1:%d' % coord_port
+        os.environ['AUTODIST_PS_PIPELINE_DEPTH'] = str(depth)
+        os.environ['AUTODIST_NUM_PROCESSES'] = '2'
+
+        def session_sees_one_process():
+            os.environ['AUTODIST_NUM_PROCESSES'] = '1'
+
+        yield session_sees_one_process
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

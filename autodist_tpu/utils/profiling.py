@@ -22,6 +22,11 @@ Usage::
     sess.run(fetches, feed, options=RunOptions(trace_level=FULL_TRACE))
     report = per_op_breakdown(options.trace_dir)
     print(format_breakdown(report))
+
+Beyond XLA traces, :func:`ps_overlap_report` attributes the loose-mode
+PS data plane's wire time between the critical path and the background
+pipeline thread (``AUTODIST_PS_PIPELINE_DEPTH``), from the phase
+counters every loose session keeps (``Session.ps_stats``).
 """
 import glob
 import os
@@ -188,6 +193,55 @@ def collective_timeline(trace_dir, line_name='XLA Ops'):
                     re.sub(r'[.\d]+$', '', base)):
             rows.append((name, ns, cnt))
     return rows
+
+
+def ps_overlap_report(ps_stats):
+    """Attribute the loose-mode PS data plane's wire time to the
+    critical path vs the background pipeline.
+
+    ``ps_stats`` is :attr:`Session.ps_stats` (whose ``pipeline`` block
+    carries the per-train-step phase averages). Wire seconds recorded
+    by the transfer/pipeline threads count as *hidden* except for the
+    portion the main thread measurably blocked on (joins of the
+    background push and of the prefetched pull) — that exposed share is
+    the only wire time a step actually pays, and ``overlap_frac`` is
+    the hidden fraction. At depth 1 every wire second is exposed by
+    construction (overlap_frac == 0).
+
+    Returns ``{'depth', 'train_steps', 'pull_s', 'step_s', 'push_s',
+    'wire_s', 'exposed_wire_s', 'hidden_wire_s', 'overlap_frac'}``
+    (per-step seconds), or ``{}`` when the session never trained in
+    loose mode.
+    """
+    pipe = (ps_stats or {}).get('pipeline') or {}
+    if not pipe.get('train_steps'):
+        return {}
+    wire = pipe['pull_s'] + pipe['push_s']
+    exposed = min(pipe['exposed_wait_s'], wire)
+    return {
+        'depth': pipe['depth'],
+        'train_steps': pipe['train_steps'],
+        'pull_s': pipe['pull_s'],
+        'step_s': pipe['step_s'],
+        'push_s': pipe['push_s'],
+        'wire_s': wire,
+        'exposed_wire_s': exposed,
+        'hidden_wire_s': max(0.0, wire - exposed),
+        'overlap_frac': pipe['overlap_frac'],
+    }
+
+
+def format_ps_overlap(report):
+    """Human-readable rendering of :func:`ps_overlap_report`."""
+    if not report:
+        return '(no loose-mode train steps)'
+    return ('depth=%d steps=%d  per-step: pull %.1fms | step %.1fms | '
+            'push %.1fms  wire %.1fms (%.1fms exposed)  overlap %.0f%%'
+            % (report['depth'], report['train_steps'],
+               report['pull_s'] * 1e3, report['step_s'] * 1e3,
+               report['push_s'] * 1e3, report['wire_s'] * 1e3,
+               report['exposed_wire_s'] * 1e3,
+               100.0 * report['overlap_frac']))
 
 
 def format_breakdown(report, top_n=10, name_width=100):

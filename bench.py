@@ -563,6 +563,122 @@ def _bench_simulator_inner(steps):
     return out
 
 
+def bench_ps_pipeline(steps=6):
+    """Loose-mode async-PS data-plane A/B (ISSUE 3 acceptance).
+
+    Runs the SAME single-process loose-mode workload (PS strategy,
+    coord-service data plane, an input-pipeline-style host interval
+    between steps) at ``AUTODIST_PS_PIPELINE_DEPTH=1`` (serial pull ->
+    step -> push) and ``=2`` (background push + pull-ahead), and
+    records per-step wall time, the pull/step/push phase breakdown and
+    the measured ``overlap_frac`` for both — the depth-2 win every
+    BENCH round tracks. Also reports the max abs difference of the
+    final variable state across depths (one worker is deterministic,
+    so the pipeline must not change the math: expected 0.0).
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_ps_pipeline_inner(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _loose_ps_run(depth, steps, port, dim=640, host_tail_s=0.04):
+    """One fresh single-process loose-mode session at ``depth``:
+    ``steps`` timed SGD steps (after a compile/warmup step) with a
+    host-side inter-step interval emulating an input pipeline — the
+    tail the pipeline hides wire time behind. Returns
+    (per-step wall seconds, ps_stats, final W).
+
+    The build-sees-2/session-sees-1 env dance lives in
+    ``utils.loose_harness.single_process_loose_env`` (shared with
+    tests/test_async_ps.py).
+    """
+    import time
+
+    import autodist_tpu as ad
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+
+    with single_process_loose_env(port, depth) as session_sees_one:
+        autodist = ad.AutoDist(
+            resource_info={'nodes': [
+                {'address': 'localhost', 'gpus': [0], 'chief': True,
+                 'network_bandwidth': 100}]},
+            strategy_builder=ad.strategy.PS(staleness=2))
+        rng = np.random.RandomState(0)
+        W0 = rng.randn(dim, dim).astype(np.float32)
+        feed = rng.randn(8, dim).astype(np.float32)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None, dim], dtype=np.float32,
+                               name='x')
+            W = ad.Variable(W0, name='W')
+            loss = ad.ops.reduce_mean(
+                ad.ops.square(ad.ops.matmul(x, W)))
+            train_op = ad.optimizers.SGD(0.01).minimize(loss, [W])
+            autodist._build()   # sees 2 processes -> loose mode
+            session_sees_one()
+            sess = autodist.create_distributed_session()
+            sess.run(train_op, {x: feed})       # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                time.sleep(host_tail_s)         # input-pipeline interval
+                sess.run(train_op, {x: feed})
+            # authoritative read drains the pipeline: both depths pay
+            # their last push inside the timed window (fair walls)
+            w_final = sess.get_variable_value('W')
+            dt = (time.perf_counter() - t0) / steps
+            stats = sess.ps_stats
+            sess.close()
+        return dt, stats, w_final
+
+
+def _bench_ps_pipeline_inner(steps):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+    from autodist_tpu.utils.profiling import ps_overlap_report
+
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    try:
+        d1, stats1, w1 = _loose_ps_run(1, steps, port)
+        d2, stats2, w2 = _loose_ps_run(2, steps, port)
+    finally:
+        # teardown must never clobber measured results: a lingering
+        # service is the launcher's leak to clean, not a bench failure
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    def block(dt, stats):
+        rep = ps_overlap_report(stats)
+        return {'per_step_wall_s': round(dt, 5),
+                'pull_s': round(rep.get('pull_s', 0.0), 5),
+                'step_s': round(rep.get('step_s', 0.0), 5),
+                'push_s': round(rep.get('push_s', 0.0), 5),
+                'exposed_wire_s': round(rep.get('exposed_wire_s', 0.0),
+                                        5),
+                'overlap_frac': round(rep.get('overlap_frac', 0.0), 3)}
+
+    return {
+        'steps_per_depth': steps,
+        'depth1': block(d1, stats1),
+        'depth2': block(d2, stats2),
+        'depth2_speedup': round(d1 / d2, 3) if d2 > 0 else 0.0,
+        'state_max_abs_diff': float(np.abs(w1 - w2).max()),
+    }
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -679,6 +795,7 @@ def main():
         # every emitted record carries the grad-sync contract fields
         result['extra']['grad_sync'] = bench_grad_sync()
         result['extra']['simulator'] = bench_simulator()
+        result['extra']['ps_pipeline'] = bench_ps_pipeline()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -692,6 +809,7 @@ def main():
     img_ps, rn_fps, rn_xla, rn_stats = bench_resnet101(n, steps, on_tpu)
     grad_sync = bench_grad_sync()
     simulator = bench_simulator()
+    ps_pipeline = bench_ps_pipeline()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -707,6 +825,7 @@ def main():
                 'cpu_fallback': fell_back,
                 'grad_sync': grad_sync,
                 'simulator': simulator,
+                'ps_pipeline': ps_pipeline,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -757,7 +876,8 @@ def main():
                       'platform': dev.platform,
                       'cpu_fallback': fell_back,
                       'grad_sync': grad_sync,
-                      'simulator': simulator},
+                      'simulator': simulator,
+                      'ps_pipeline': ps_pipeline},
         }
     print(json.dumps(result))
 

@@ -333,18 +333,20 @@ def test_vget_even_parity_exhaustion_returns(coord, monkeypatch):
     pusher = coord()
     t = np.arange(10, dtype=np.float32)
     c.vset('skew', t)
-    real_rpc = CoordClient._rpc
+    real_send = CoordClient._send_frame
 
-    def rpc_with_push(self, line, payload=None):
-        # a whole single-frame push lands before every BGET chunk, so
-        # the version advances (even parity) between this pull's chunks
-        # on every attempt
+    def send_with_push(self, line, payload=None):
+        # a whole single-frame push lands before every BGET request
+        # frame goes out (vmget pipelines the frames, so this is the
+        # pre-chunk hook), so the version advances (even parity)
+        # between this pull's chunks on every attempt
         if self is c and line.startswith('BGET skew'):
-            real_rpc(pusher, 'BADD skew 40 f32',
-                     np.ones(10, np.float32).tobytes())
-        return real_rpc(self, line, payload)
+            real_send(pusher, 'BADD skew 40 f32',
+                      np.ones(10, np.float32).tobytes())
+            assert pusher._read_reply_line().startswith('VAL')
+        return real_send(self, line, payload)
 
-    monkeypatch.setattr(CoordClient, '_rpc', rpc_with_push)
+    monkeypatch.setattr(CoordClient, '_send_frame', send_with_push)
     got = c.vget('skew', shape=(10,))   # must NOT raise
     assert got.shape == (10,)
     # rows are base + k pushes; chunks may straddle one push boundary

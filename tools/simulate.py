@@ -94,6 +94,13 @@ def main(argv=None):
                         '2 Adam-like, 1 momentum)')
     p.add_argument('--calibrate-trace', default='',
                    help='profiler trace dir to refine alpha-beta from')
+    p.add_argument('--ps-overlap', type=float, default=0.0,
+                   help='async-PS pull-ahead haircut in [0, 1): the '
+                        'fraction of PS param-phase wire time the '
+                        'pipelined data plane '
+                        '(AUTODIST_PS_PIPELINE_DEPTH>=2) hides; take it '
+                        'from a measured ps_stats overlap_frac. 0 '
+                        '(default) prices the serial depth-1 plane')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -109,6 +116,10 @@ def main(argv=None):
     rs = build_resource_spec(args)
     gi = PytreeGraphItem(model)
     params = CostModelParams.from_topology(rs.topology)
+    if not 0.0 <= args.ps_overlap < 1.0:
+        raise SystemExit('--ps-overlap must be in [0, 1); got %r'
+                         % args.ps_overlap)
+    params.ps_overlap_discount = args.ps_overlap
     n = args.replicas or None
     if args.calibrate_trace:
         from autodist_tpu.strategy.builders import replica_devices
