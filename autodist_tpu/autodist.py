@@ -13,6 +13,7 @@ import atexit
 import base64
 import json
 import os
+import time
 
 import numpy as np
 
@@ -231,9 +232,50 @@ class AutoDist:
             if IS_AUTODIST_CHIEF:
                 self._coord.delete('strategy/%s/id' % ns)
                 self._coord.delete('strategy/%s/blob' % ns)
-            self._coord.barrier('ctrl/init/%s' % ns,
-                                ENV.AUTODIST_NUM_PROCESSES.val,
-                                timeout_s=120.0)
+                # a reused service may hold a PREVIOUS run's init-done
+                # marker: left in place it would let this run's workers
+                # skip the barrier below and read strategy keys before
+                # the deletes above have landed
+                self._coord.delete('ctrl/init-done/%s' % ns)
+                self._coord.barrier('ctrl/init/%s' % ns,
+                                    ENV.AUTODIST_NUM_PROCESSES.val,
+                                    timeout_s=120.0)
+                # elastic rejoin: record that the init rendezvous
+                # happened, so a supervised REPLACEMENT worker started
+                # after a crash doesn't block on a barrier its original
+                # cohort already passed (the strategy keys are stable
+                # from here on)
+                self._coord.set('ctrl/init-done/%s' % ns, '1')
+            else:
+                # A worker cannot locally distinguish "fresh cohort
+                # member" from "supervised replacement whose cohort
+                # already passed this barrier", so it ALWAYS tries the
+                # barrier first and consults the init-done marker only
+                # between bounded slices. Reading the marker up front
+                # would race the chief's stale-marker delete above: on
+                # a reused service holding a previous run's marker, a
+                # fresh worker arriving before the chief could skip the
+                # rendezvous the chief is counting it into and read
+                # strategy keys mid-delete. A replacement pays one
+                # slice of latency before the marker releases it; a
+                # replacement of a worker that died BEFORE the
+                # rendezvous simply fills the dead slot (no marker
+                # exists yet, and the cohort needs its arrival).
+                deadline = time.time() + 120.0
+                while True:
+                    try:
+                        self._coord.barrier(
+                            'ctrl/init/%s' % ns,
+                            ENV.AUTODIST_NUM_PROCESSES.val,
+                            timeout_s=min(10.0, max(
+                                1.0, deadline - time.time())))
+                        break
+                    except TimeoutError:
+                        if self._coord.get(
+                                'ctrl/init-done/%s' % ns) is not None:
+                            break
+                        if time.time() >= deadline:
+                            raise
 
     @staticmethod
     def _strategy_is_loose(strategy):

@@ -77,6 +77,16 @@ def _min_int(name, raw, default, lo):
     return val
 
 
+def _choice(name, raw, default, allowed):
+    """Validated env parse: one of a closed set of strings."""
+    if not raw:
+        return default
+    if raw not in allowed:
+        raise ValueError('%s must be one of %s; got %r'
+                         % (name, '|'.join(allowed), raw))
+    return raw
+
+
 class ENV(Enum):
     """Typed environment flags, each with a default-producing lambda.
 
@@ -161,6 +171,50 @@ class ENV(Enum):
     # be in flight without breaking read-your-writes).
     AUTODIST_PS_PIPELINE_DEPTH = \
         (lambda v: _min_int('AUTODIST_PS_PIPELINE_DEPTH', v, 1, lo=1),)
+    # loose-mode peer-failure policy (runtime/session.py): what a
+    # surviving worker does when a peer misses heartbeats past
+    # AUTODIST_HEARTBEAT_TIMEOUT while it waits on the staleness gate.
+    #   fail    - raise (the pre-recovery fail-fast behavior; default)
+    #   exclude - fence the dead peer's writer generation, drop it from
+    #             the gate membership (epoch bump) and keep training,
+    #             bounded below by AUTODIST_MIN_WORKERS
+    #   restart - keep waiting while the Coordinator supervises a
+    #             capped-backoff restart of the dead worker; raise only
+    #             once the supervisor marks it permanently failed
+    AUTODIST_PEER_FAILURE_POLICY = \
+        (lambda v: _choice('AUTODIST_PEER_FAILURE_POLICY', v, 'fail',
+                           ('fail', 'exclude', 'restart')),)
+    # floor for policy=exclude: a membership that would drop below this
+    # many live workers fails instead of shrinking further.
+    AUTODIST_MIN_WORKERS = \
+        (lambda v: _min_int('AUTODIST_MIN_WORKERS', v, 1, lo=1),)
+    # policy=restart: how many supervised restarts one worker gets
+    # (capped exponential backoff between attempts) before the
+    # coordinator marks it permanently failed and aborts the run.
+    AUTODIST_MAX_WORKER_RESTARTS = \
+        (lambda v: _min_int('AUTODIST_MAX_WORKER_RESTARTS', v, 3, lo=0),)
+    # policy=restart: how long survivors wait at the staleness gate for
+    # ONE dead peer's supervised replacement to start beating again
+    # before giving up. The gate's own window re-arms while a restart
+    # is pending (respawn + rejoin + recompile can legitimately exceed
+    # it); this is the backstop against a silently dead supervisor —
+    # the normal abort path is the supervisor's failed marker. Covers
+    # the full restart budget: every backoff plus a cold XLA compile.
+    AUTODIST_RESTART_WAIT_S = \
+        (lambda v: _positive_float('AUTODIST_RESTART_WAIT_S', v,
+                                   1800.0),)
+    # chief-side auto-checkpoint backstop for loose-mode recovery: save
+    # the chief's variable state every N train steps through
+    # checkpoint.CheckpointManager (async, off the critical path).
+    # 0 disables (default).
+    AUTODIST_AUTO_CHECKPOINT_EVERY = \
+        (lambda v: _min_int('AUTODIST_AUTO_CHECKPOINT_EVERY', v, 0,
+                            lo=0),)
+    # deterministic fault-injection plan (utils/faultline.py): inline
+    # JSON, or @/path/to/plan.json. Empty = no faults. Only honored
+    # when the process explicitly installs a FaultLine (chaos tests,
+    # bench recovery A/B) — production sessions never read it.
+    AUTODIST_FAULT_PLAN = (lambda v: v if v else '',)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

@@ -57,7 +57,19 @@
 //
 // Protocol: newline-terminated text commands over TCP; the B* commands
 // carry a length-prefixed raw payload immediately after the newline.
+// Writer fencing (elastic recovery): `FENCE <key> <gen>` binds the
+// connection to generation <gen> of the counter <key>. Once that
+// counter advances past the bound generation (a survivor or the
+// supervising coordinator declared this writer dead and bumped it),
+// every mutating command on the connection — SET, DEL, DELNS, INCR,
+// BSET, BADD, BSTEP — is rejected with `ERR fenced`, so a zombie can
+// never corrupt state after its replacement joins under a fresh
+// generation. Reads and waits stay open (a zombie observing the world
+// is harmless; only its writes are dangerous).
+//
 //   AUTH <hmac-hex>              -> OK | ERR (connection greeting reply)
+//   FENCE <key> <gen>            -> OK | ERR fenced (bind this
+//                                    connection's writer generation)
 //   SET <key> <value>            -> OK
 //   GET <key>                    -> VAL <value> | NONE
 //   DEL <key>                    -> OK
@@ -102,6 +114,7 @@
 #include <memory>
 #include <mutex>
 #include <random>
+#include <set>
 #include <sstream>
 #include <string>
 #include <string_view>
@@ -133,10 +146,11 @@ struct Tensor {
   // `version*2 + (open_writes>0)` in its reply: an odd value or a
   // value that moves across a reader's chunks means the read raced a
   // writer and must be retried.  Every error reply closes the sequence
-  // it opened (abort), so a rejected write cannot wedge the counter; a
-  // writer that dies silently mid-sequence leaves it stuck until DELNS
-  // removes the tensor — readers surface that as a stalled-odd error
-  // rather than torn data.
+  // it opened (abort), so a rejected write cannot wedge the counter,
+  // and a writer whose CONNECTION dies mid-sequence has its open
+  // sequences aborted at disconnect (serve_conn's SeqAborter) — only a
+  // writer alive-but-stalled past the client's stall window surfaces
+  // to readers as a stalled-odd error rather than torn data.
   int64_t version = 0;
   int64_t open_writes = 0;
 };
@@ -155,6 +169,49 @@ struct Store {
 Store g_store;
 std::string g_token;  // empty = open service (loopback-only deployments)
 
+// Per-connection writer fencing. A connection that bound itself to a
+// fence counter via FENCE is a generation-g writer; once the counter
+// advances past g every mutating command on the connection is
+// rejected. Unfenced connections (fence_key empty) write freely — the
+// pre-recovery protocol, and reads never fence.
+struct ConnState {
+  std::string fence_key;
+  int64_t fence_gen = 0;
+  // Chunked write sequences THIS connection opened (offset-0 frame
+  // seen, final chunk not yet) — touched only by the connection's own
+  // serving thread. Aborted when the connection dies: a writer killed
+  // between chunks (the exclude/restart policies' died-mid-push case)
+  // sends no further frames, so without this the sequence would hold
+  // open_writes forever and wedge every reader on odd parity until a
+  // DELNS. TCP teardown (os._exit, host crash with RST, clean close)
+  // lands here as read_line/recv failure.
+  std::set<std::string> open_seqs;
+};
+
+constexpr const char* kFencedErr = "ERR fenced stale generation";
+
+// True when the connection's bound generation has been superseded.
+// Caller must hold g_store.mu. The KV/counter mutations check under
+// the SAME mu hold as the mutation itself — a separate check-then-act
+// would let one in-flight zombie frame commit after its fence bump.
+bool is_fenced_locked(const ConnState& conn) {
+  if (conn.fence_key.empty()) return false;
+  auto it = g_store.counters.find(conn.fence_key);
+  int64_t cur = it == g_store.counters.end() ? 0 : it->second;
+  return cur > conn.fence_gen;
+}
+
+// Locking variant. Takes g_store.mu; safe to call while holding a
+// tensor mutex (nothing acquires a tensor mutex under g_store.mu), so
+// the B* handlers re-check AFTER taking the tensor lock: once a fence
+// bump's INCR has been processed, no later-processed frame from the
+// stale writer can mutate the tensor.
+bool is_fenced(const ConnState& conn) {
+  if (conn.fence_key.empty()) return false;
+  std::lock_guard<std::mutex> l(g_store.mu);
+  return is_fenced_locked(conn);
+}
+
 // Bookkeeping for one mutating frame of a (possibly chunked) write
 // sequence — the single place the open_writes invariant lives for
 // BSET/BADD/BSTEP.  Construct AFTER locking the tensor: the offset-0
@@ -164,15 +221,25 @@ std::string g_token;  // empty = open service (loopback-only deployments)
 // (closes the sequence on its final chunk and bumps the version).
 struct SeqFrame {
   Tensor* t;
-  explicit SeqFrame(Tensor* t, size_t off) : t(t) {
-    if (off == 0) ++t->open_writes;
+  ConnState* conn;
+  const std::string& key;
+  SeqFrame(Tensor* t, size_t off, ConnState* conn, const std::string& key)
+      : t(t), conn(conn), key(key) {
+    if (off == 0) {
+      ++t->open_writes;
+      conn->open_seqs.insert(key);
+    }
   }
   std::string fail(const char* e) {
     if (t->open_writes > 0) --t->open_writes;
+    conn->open_seqs.erase(key);
     return e;
   }
   void finish(bool final_chunk) {
-    if (final_chunk && t->open_writes > 0) --t->open_writes;
+    if (final_chunk) {
+      if (t->open_writes > 0) --t->open_writes;
+      conn->open_seqs.erase(key);
+    }
     ++t->version;
   }
 };
@@ -197,15 +264,50 @@ std::shared_ptr<Tensor> find_tensor(const std::string& key, bool create) {
 // would close ANOTHER writer's in-flight chunked sequence and clear the
 // torn-read parity bit under that writer's feet. `off_declared` is the
 // frame's raw declared offset (-1 when absent/unparsable).
-std::string abort_open_seq(const std::string& key, int64_t off_declared,
-                           const char* e) {
+std::string abort_open_seq(ConnState* conn, const std::string& key,
+                           int64_t off_declared, const char* e) {
   if (off_declared <= 0) return e;
+  conn->open_seqs.erase(key);
   std::shared_ptr<Tensor> t = find_tensor(key, /*create=*/false);
   if (t) {
     std::lock_guard<std::mutex> l(t->mu);
     if (t->open_writes > 0) --t->open_writes;
   }
   return e;
+}
+
+// Disconnect-time abort of every sequence the connection still holds
+// open: a writer that died mid-chunked-push will never send the final
+// chunk, and its readers must not stay wedged on odd parity until a
+// DELNS. Same semantics as the per-frame aborts — release the
+// open_writes slot, leave the (partial) data for the staleness model
+// to absorb like any other bounded-lag contribution.
+void abort_conn_seqs(ConnState* conn) {
+  for (const std::string& key : conn->open_seqs) {
+    std::shared_ptr<Tensor> t = find_tensor(key, /*create=*/false);
+    if (!t) continue;
+    std::lock_guard<std::mutex> l(t->mu);
+    if (t->open_writes > 0) --t->open_writes;
+  }
+  conn->open_seqs.clear();
+}
+
+// Fencing re-check for the B* handlers, run AFTER taking the tensor
+// lock (caller holds t->mu): the wire-entry is_fenced check alone is
+// not enough — a fence bump landing between it and the tensor lock
+// would let one in-flight zombie frame commit after its exclusion
+// became observable. Inlines the sequence abort (abort_open_seq would
+// re-lock t->mu): a fenced continuation chunk releases the open_writes
+// slot its sequence holds so readers are not wedged on odd parity.
+bool reject_fenced_under_tensor_lock(ConnState* conn,
+                                     const std::string& key, Tensor* t,
+                                     int64_t off_decl) {
+  if (!is_fenced(*conn)) return false;
+  if (off_decl > 0 && t->open_writes > 0) {
+    --t->open_writes;
+    conn->open_seqs.erase(key);
+  }
+  return true;
 }
 
 // The raw declared offset of a B* command's optional trailing
@@ -510,18 +612,34 @@ bool read_range(std::istringstream* in, size_t n_elems, size_t* off,
 // commands); a BGET reply's bytes land in `reply_payload` and follow the
 // returned header line on the wire.
 std::string handle(const std::string& line, std::string_view payload,
-                   std::string* reply_payload) {
+                   std::string* reply_payload, ConnState* conn) {
   std::istringstream in(line);
   std::string cmd;
   in >> cmd;
   using namespace std::chrono;
   if (cmd == "PING") return "PONG";
+  if (cmd == "FENCE") {
+    std::string k;
+    int64_t gen = -1;
+    in >> k >> gen;
+    if (k.empty() || gen < 0) return "ERR bad fence";
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto it = g_store.counters.find(k);
+    int64_t cur = it == g_store.counters.end() ? 0 : it->second;
+    // a would-be writer whose generation is already superseded must
+    // learn it at bind time, not at its first rejected write
+    if (cur > gen) return kFencedErr;
+    conn->fence_key = k;
+    conn->fence_gen = gen;
+    return "OK";
+  }
   if (cmd == "SET") {
     std::string k, v;
     in >> k;
     std::getline(in, v);
     if (!v.empty() && v[0] == ' ') v.erase(0, 1);
     std::lock_guard<std::mutex> l(g_store.mu);
+    if (is_fenced_locked(*conn)) return kFencedErr;
     g_store.kv[k] = v;
     g_store.cv.notify_all();
     return "OK";
@@ -537,6 +655,9 @@ std::string handle(const std::string& line, std::string_view payload,
     std::string k;
     in >> k;
     std::lock_guard<std::mutex> l(g_store.mu);
+    // deletes are mutations: a fenced zombie erasing live keys (or a
+    // whole namespace below) corrupts state as surely as a write
+    if (is_fenced_locked(*conn)) return kFencedErr;
     g_store.kv.erase(k);
     g_store.counters.erase(k);
     return "OK";
@@ -548,6 +669,7 @@ std::string handle(const std::string& line, std::string_view payload,
     in >> prefix;
     if (prefix.empty()) return "ERR empty prefix";
     std::lock_guard<std::mutex> l(g_store.mu);
+    if (is_fenced_locked(*conn)) return kFencedErr;
     size_t n = erase_prefix(&g_store.kv, prefix);
     n += erase_prefix(&g_store.counters, prefix);
     n += erase_prefix(&g_store.tensors, prefix);
@@ -561,6 +683,7 @@ std::string handle(const std::string& line, std::string_view payload,
     int64_t d = 1;
     in >> k >> d;
     std::lock_guard<std::mutex> l(g_store.mu);
+    if (d != 0 && is_fenced_locked(*conn)) return kFencedErr;
     int64_t v = (g_store.counters[k] += d);
     g_store.cv.notify_all();
     return "VAL " + std::to_string(v);
@@ -622,15 +745,20 @@ std::string handle(const std::string& line, std::string_view payload,
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
     const int64_t off_decl = declared_offset(&in);
+    // a writer fenced mid-sequence aborts the sequence it opened
+    // (abort_open_seq) so its readers are not wedged on odd parity
+    if (is_fenced(*conn)) return abort_open_seq(conn, k, off_decl, kFencedErr);
     std::vector<float> vals;
     if (!decode_wire(payload, wire, &vals))
-      return abort_open_seq(k, off_decl, "ERR bad payload");
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, vals.size(), &off, &total))
-      return abort_open_seq(k, off_decl, "ERR bad range");
+      return abort_open_seq(conn, k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    SeqFrame seq(t.get(), off);
+    if (reject_fenced_under_tensor_lock(conn, k, t.get(), off_decl))
+      return kFencedErr;
+    SeqFrame seq(t.get(), off, conn, k);
     if (off == 0) {  // a (re)set starts at its first chunk
       t->data.assign(total, 0.f);
       t->slot1.clear();
@@ -696,15 +824,18 @@ std::string handle(const std::string& line, std::string_view payload,
     size_t nbytes = 0;
     in >> k >> nbytes >> wire;
     const int64_t off_decl = declared_offset(&in);
+    if (is_fenced(*conn)) return abort_open_seq(conn, k, off_decl, kFencedErr);
     std::vector<float> delta;
     if (!decode_wire(payload, wire, &delta))
-      return abort_open_seq(k, off_decl, "ERR bad payload");
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, delta.size(), &off, &total))
-      return abort_open_seq(k, off_decl, "ERR bad range");
+      return abort_open_seq(conn, k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/true);
     std::lock_guard<std::mutex> l(t->mu);
-    SeqFrame seq(t.get(), off);
+    if (reject_fenced_under_tensor_lock(conn, k, t.get(), off_decl))
+      return kFencedErr;
+    SeqFrame seq(t.get(), off, conn, k);
     if (t->data.empty()) t->data.assign(total, 0.f);
     if (t->data.size() != total) return seq.fail("ERR shape mismatch");
     if (off == 0) ++t->pushes;  // one logical push counts once
@@ -720,16 +851,19 @@ std::string handle(const std::string& line, std::string_view payload,
     double p0 = 0, p1 = 0, p2 = 0, p3 = 0;
     in >> k >> nbytes >> wire >> rule >> t_in >> p0 >> p1 >> p2 >> p3;
     const int64_t off_decl = declared_offset(&in);
+    if (is_fenced(*conn)) return abort_open_seq(conn, k, off_decl, kFencedErr);
     std::vector<float> grad;
     if (!decode_wire(payload, wire, &grad))
-      return abort_open_seq(k, off_decl, "ERR bad payload");
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
     size_t off, total;
     if (!read_range(&in, grad.size(), &off, &total))
-      return abort_open_seq(k, off_decl, "ERR bad range");
+      return abort_open_seq(conn, k, off_decl, "ERR bad range");
     std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
     if (!t) return "ERR no tensor";
     std::lock_guard<std::mutex> l(t->mu);
-    SeqFrame seq(t.get(), off);
+    if (reject_fenced_under_tensor_lock(conn, k, t.get(), off_decl))
+      return kFencedErr;
+    SeqFrame seq(t.get(), off, conn, k);
     if (t->data.size() != total) return seq.fail("ERR shape mismatch");
     int64_t step = t_in;
     if (off == 0 && step == 0) step = ++t->steps;
@@ -785,9 +919,7 @@ std::string handle(const std::string& line, std::string_view payload,
     } else {
       return seq.fail("ERR unknown rule");
     }
-    if (off + grad.size() >= total && t->open_writes > 0)
-      --t->open_writes;
-    ++t->version;
+    seq.finish(off + grad.size() >= total);
     return "VAL " + std::to_string(step);
   }
   if (cmd == "SHUTDOWN") {
@@ -827,6 +959,14 @@ bool read_line(int fd, std::string* buf, std::string* line) {
 void serve_conn(int fd) {
   std::string buf;
   char chunk[1 << 16];
+  ConnState conn;
+  // fires on EVERY exit path: a connection that dies mid-chunked-write
+  // (worker crash = recv failure/EOF) aborts the sequences it opened
+  // instead of wedging their readers on odd parity forever
+  struct SeqAborter {
+    ConnState* c;
+    ~SeqAborter() { abort_conn_seqs(c); }
+  } seq_aborter{&conn};
   // greeting + handshake: with a token configured every connection must
   // answer the nonce challenge before its first real command
   {
@@ -897,7 +1037,7 @@ void serve_conn(int fd) {
     // and the buffer is erased only after it returns
     std::string_view payload(buf.data(), need);
     std::string reply_payload;
-    std::string resp = handle(line, payload, &reply_payload) + "\n";
+    std::string resp = handle(line, payload, &reply_payload, &conn) + "\n";
     buf.erase(0, need);
     if (!send_all(fd, resp.data(), resp.size()) ||
         (!reply_payload.empty() &&

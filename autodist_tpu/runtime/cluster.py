@@ -119,6 +119,11 @@ class Cluster:
         if self._started and ENV.AUTODIST_NUM_PROCESSES.val > 1:
             try:
                 jax.distributed.shutdown()
-            except Exception:   # noqa: BLE001 - best-effort teardown
-                pass
+            except Exception as e:   # noqa: BLE001 - best-effort teardown
+                # best-effort, but never silent: a shutdown failure here
+                # is the first clue when a later run's initialize hangs
+                # on a half-dead coordinator
+                logging.warning('jax.distributed.shutdown failed during '
+                                'terminate (continuing): %s: %s',
+                                type(e).__name__, e)
         self._started = False

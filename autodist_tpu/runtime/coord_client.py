@@ -51,6 +51,38 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     _BF16 = None
 
 
+class FencedWriteError(OSError):
+    """A write was rejected because this connection's fencing
+    generation has been superseded — this process was declared dead
+    and a survivor (or its own replacement) bumped its fence counter.
+    A zombie receiving this must stop writing; recovery belongs to the
+    supervising coordinator, not to the fenced process."""
+
+
+# process-wide connection-retry accounting (profiling.health_report):
+# every failed connect attempt inside connect_with_retry counts here.
+RETRY_STATS = {'connect_retries': 0}
+
+
+def _check_fenced(resp, what):
+    """Raise the typed fencing error on an `ERR fenced` reply."""
+    if resp.startswith('ERR fenced'):
+        raise FencedWriteError(
+            '%s rejected: writer generation fenced (this process was '
+            'declared dead and superseded)' % what)
+    return resp
+
+
+def _raise_batch(errs):
+    """Raise a pipelined batch's aggregated errors, keeping the typed
+    fencing error when any reply was a fence rejection (a zombie's
+    whole batch dies the moment its generation is superseded)."""
+    msg = '; '.join(errs)
+    if any('ERR fenced' in e for e in errs):
+        raise FencedWriteError(msg)
+    raise OSError(msg)
+
+
 def coord_token():
     """The coord-service shared secret, or '' for an open service.
 
@@ -146,7 +178,24 @@ def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
             return proc
         except OSError:
             time.sleep(0.05)
-    raise RuntimeError('coord_service failed to start on :%d' % port)
+    # the spawned process may be alive but unresponsive (or still
+    # binding): kill it before raising, or it leaks as an orphan
+    # holding the port and every subsequent start attempt on this
+    # port fails against the half-dead listener
+    proc.terminate()
+    try:
+        proc.wait(timeout=5.0)
+    except subprocess.TimeoutExpired:  # pragma: no cover - stuck child
+        proc.kill()
+        proc.wait(timeout=5.0)
+    raise RuntimeError('coord_service failed to start on :%d '
+                       '(spawned pid %d killed)' % (port, proc.pid))
+
+
+# A step counter at/above this value means the worker has LEFT the run
+# (clean close, or an exclude-policy release of a dead peer's counter),
+# not that it trained 2^30 steps — see publish_step's release note.
+CLEAN_CLOSE_STEP = 1 << 30
 
 
 def ps_endpoints():
@@ -183,9 +232,18 @@ def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
     healthy pull (observed as a flaky 4-worker x 105 MB test on a
     loaded one-core host). Callers that need FAST failure detection on
     an established connection (e.g. heartbeat loops) pass a small
-    ``op_timeout`` instead."""
+    ``op_timeout`` instead.
+
+    Retries back off exponentially (0.05 s doubling to a 2 s cap) with
+    ±25% deterministic-free jitter so a herd of workers restarted
+    together does not hammer the service in lockstep; the final
+    RuntimeError chains ``from`` the last OSError so the root cause
+    (ECONNREFUSED vs EHOSTUNREACH vs auth failure) survives into the
+    traceback."""
+    import random
     deadline = time.time() + deadline_s
     last = None
+    delay = 0.05
     while time.time() < deadline:
         try:
             c = CoordClient(address, timeout=5.0, op_timeout=op_timeout)
@@ -193,13 +251,24 @@ def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
             return c
         except OSError as e:
             last = e
-            time.sleep(0.1)
+            RETRY_STATS['connect_retries'] += 1
+            time.sleep(min(delay * (1.0 + random.uniform(-0.25, 0.25)),
+                           max(0.0, deadline - time.time())))
+            delay = min(delay * 2.0, 2.0)
     raise RuntimeError('coord_service unreachable at %s: %s'
-                       % (address, last))
+                       % (address, last)) from last
 
 
 class CoordClient:
     """Blocking line-protocol client."""
+
+    # Fault-injection hook (utils/faultline.py): when set (class-wide,
+    # chaos tests / bench recovery only), called as
+    # ``hook(client, line, payload)`` before every request frame hits
+    # the wire. The hook may raise (drop/close faults), sleep (delay
+    # faults) or return a replacement ``(line, payload)`` (torn-frame
+    # faults). None in production — one attribute test per frame.
+    fault_hook = None
 
     # How long a torn pull waits for an in-flight chunked write whose
     # version has stopped advancing before declaring the writer dead.
@@ -287,6 +356,11 @@ class CoordClient:
         """Write one request frame (header line + optional raw payload)
         WITHOUT reading its reply — the building block the pipelined
         multi-tensor calls (vmget/vmset/vmadd) write batches of."""
+        hook = CoordClient.fault_hook
+        if hook is not None:
+            replaced = hook(self, line, payload)
+            if replaced is not None:
+                line, payload = replaced
         header = line.encode() + b'\n'
         if payload is not None and len(payload) > 65536:
             # large tensor frames: send header + payload separately to
@@ -351,18 +425,32 @@ class CoordClient:
             # whatever is on this port, it is not a coord service
             raise OSError('unexpected PING reply %r' % resp[:64])
 
+    def fence(self, key, gen):
+        """Bind this connection as a generation-``gen`` writer of fence
+        counter ``key``: once that counter advances past ``gen`` (this
+        process was declared dead), every write on the connection is
+        rejected with :class:`FencedWriteError`. Raises immediately if
+        the generation is already superseded."""
+        resp = _check_fenced(self._rpc('FENCE %s %d' % (key, gen)),
+                             'fence(%s, %d)' % (key, gen))
+        if resp != 'OK':
+            raise OSError('FENCE %s failed: %s' % (key, resp))
+
     def set(self, key, value):
-        assert self._rpc('SET %s %s' % (key, value)) == 'OK'
+        resp = _check_fenced(self._rpc('SET %s %s' % (key, value)),
+                             'set(%s)' % key)
+        assert resp == 'OK'
 
     def get(self, key):
         resp = self._rpc('GET %s' % key)
         return None if resp == 'NONE' else resp[4:]
 
     def delete(self, key):
-        self._rpc('DEL %s' % key)
+        _check_fenced(self._rpc('DEL %s' % key), 'delete(%s)' % key)
 
     def incr(self, key, delta=1):
-        resp = self._rpc('INCR %s %d' % (key, delta))
+        resp = _check_fenced(self._rpc('INCR %s %d' % (key, delta)),
+                             'incr(%s)' % key)
         return int(resp[4:])
 
     def _timed_rpc(self, line, timeout_s):
@@ -461,7 +549,7 @@ class CoordClient:
 
         self._pipelined(frames, reply)
         if errs:
-            raise OSError('; '.join(errs))
+            _raise_batch(errs)
 
     def vget(self, key, shape=None, dtype=np.float32, wire=None):
         """Fetch a tensor as float32 host array, or None if absent.
@@ -654,7 +742,7 @@ class CoordClient:
 
         self._pipelined(frames, reply)
         if errs:
-            raise OSError('; '.join(errs))
+            _raise_batch(errs)
         return pushes
 
     def vstep(self, key, grad, rule, params, wire=None):
@@ -678,10 +766,11 @@ class CoordClient:
             payload = _encode(flat[off:off + count], wire)
             suffix = '' if len(ranges) == 1 else \
                 ' %d %d' % (off, flat.size)
-            resp = self._rpc(
+            resp = _check_fenced(self._rpc(
                 'BSTEP %s %d %s %s %d %.17g %.17g %.17g %.17g%s'
                 % (key, len(payload), wire, rule, step,
-                   p[0], p[1], p[2], p[3], suffix), payload)
+                   p[0], p[1], p[2], p[3], suffix), payload),
+                'vstep(%s)' % key)
             if not resp.startswith('VAL'):
                 raise OSError('BSTEP %s failed: %s' % (key, resp))
             step = int(resp[4:])
@@ -704,7 +793,8 @@ class CoordClient:
         """Purge every key/counter/tensor/barrier under ``prefix`` —
         run-end cleanup so a long-lived endpoint daemon does not
         accumulate dead runs' tensors. Returns the entry count purged."""
-        resp = self._rpc('DELNS %s' % prefix)
+        resp = _check_fenced(self._rpc('DELNS %s' % prefix),
+                             'delete_namespace(%s)' % prefix)
         if not resp.startswith('VAL'):
             raise OSError('DELNS %s failed: %s' % (prefix, resp))
         return int(resp[4:])
@@ -723,6 +813,11 @@ class CoordClient:
         self._sock.close()
 
     # -- composite: bounded staleness -------------------------------------
+    # A step publish landing at/above CLEAN_CLOSE_STEP is a RELEASE, not
+    # training progress: Session.close and the exclude-policy claim
+    # winner publish it to lift any reachable gate bound on a departed
+    # worker's counter (faultline's kill_worker matcher must never treat
+    # it as the worker reaching its death step).
     def publish_step(self, worker, step, prefix='step/'):
         """Publish this worker's completed-step counter."""
         key = prefix + worker
@@ -739,22 +834,35 @@ class CoordClient:
         known dead), the server-side wait is chunked into ``slice_s``
         slices and the check runs between slices — a crashed peer
         surfaces as its error instead of a full-window TimeoutError.
+        A TRUTHY return from ``failure_check`` means a recovery is in
+        flight (peer-failure policy ``restart``): the deadline re-arms
+        so supervision time is not counted against the gate window —
+        the caller bounds that wait itself (failed markers raise;
+        ``AUTODIST_RESTART_WAIT_S`` caps a silent supervisor).
+
+        ``num_workers`` may be a callable, re-evaluated every slice:
+        elastic membership (peer-failure policy ``exclude``) shrinks
+        the party count while a survivor is already blocked here, and
+        the gate must re-bound against the NEW membership instead of
+        waiting forever for a step key the excluder deleted.
         """
         if step <= staleness:
             return
+        k = num_workers() if callable(num_workers) else num_workers
         if failure_check is None:
-            self.min_wait(prefix, step - staleness, num_workers,
-                          timeout_s)
+            self.min_wait(prefix, step - staleness, k, timeout_s)
             return
         deadline = time.time() + timeout_s
         while True:
-            failure_check()
+            if failure_check():
+                deadline = time.time() + timeout_s
+            k = num_workers() if callable(num_workers) else num_workers
             remaining = deadline - time.time()
             if remaining <= 0:
                 raise TimeoutError('staleness_gate(%s, %d)'
                                    % (prefix, step))
             try:
-                self.min_wait(prefix, step - staleness, num_workers,
+                self.min_wait(prefix, step - staleness, k,
                               min(slice_s, remaining))
                 return
             except TimeoutError:

@@ -17,6 +17,7 @@ import shlex
 import subprocess
 import sys
 import threading
+import time
 
 from autodist_tpu.const import (DEFAULT_COORD_PORT, DEFAULT_JAX_COORD_PORT,
                                 DEFAULT_WORKING_DIR, ENV)
@@ -37,7 +38,163 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # must agree on the pipeline depth and stall window
                     ENV.AUTODIST_PS_PIPELINE_DEPTH,
                     ENV.AUTODIST_PS_STALL_TIMEOUT_S,
+                    # elastic recovery: every worker must judge peer
+                    # failures under the same policy and bounds
+                    ENV.AUTODIST_PEER_FAILURE_POLICY,
+                    ENV.AUTODIST_MIN_WORKERS,
+                    ENV.AUTODIST_MAX_WORKER_RESTARTS,
+                    ENV.AUTODIST_RESTART_WAIT_S,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
+
+
+class WorkerSupervisor:
+    """Policy-aware babysitter for ONE worker process — the recovery
+    half of the reference's fail-fast monitor (coordinator.py:98-110).
+
+    - ``fail`` (default): any nonzero exit calls ``on_give_up`` (the
+      chief aborts) — the pre-recovery behavior.
+    - ``exclude``: a dead worker is logged and left to the surviving
+      peers, which fence its generation and shrink the gate membership.
+    - ``restart``: up to ``max_restarts`` supervised respawns with
+      capped exponential backoff; the dead incarnation's writer
+      generation is fenced (``fence`` callback) BEFORE every respawn —
+      an ssh-severed zombie may still be alive on the remote host, and
+      its writes must be rejected from the moment its replacement can
+      exist. A fence attempt that fails consumes one restart attempt
+      and is retried under the backoff (never an unfenced respawn, but
+      never a whole-chief abort on one transient RPC miss either).
+      Exhausting the cap runs ``mark_failed`` (so blocked peers
+      stop waiting) and then gives up.
+
+    ``spawn``/``fence``/``mark_failed``/``on_give_up``/``sleep`` are
+    injectable so the supervision loop is unit-testable without ssh.
+    """
+
+    def __init__(self, address, spawn, policy='fail', max_restarts=0,
+                 fence=None, mark_failed=None, on_give_up=None,
+                 is_shutting_down=None, backoff_base_s=0.5,
+                 backoff_cap_s=30.0, sleep=time.sleep):
+        self.address = address
+        self.proc = None
+        self.restarts = 0
+        self._spawn = spawn
+        self._policy = policy
+        self._max_restarts = max_restarts
+        self._fence = fence
+        self._mark_failed = mark_failed
+        self._on_give_up = on_give_up or (lambda code: None)
+        self._is_shutting_down = is_shutting_down or (lambda: False)
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._sleep = sleep
+        self._thread = None
+        # serializes respawn against terminate(): either the respawn
+        # sees the shutdown flag inside the lock, or terminate() sees
+        # (and kills) the freshly assigned proc — a terminate landing
+        # between the shutdown check and the Popen cannot orphan a
+        # respawned worker nobody will ever stop
+        self._spawn_lock = threading.Lock()
+
+    def backoff_s(self, attempt):
+        """Backoff before restart ``attempt`` (1-based): exponential
+        from the base, capped."""
+        return min(self._backoff_cap_s,
+                   self._backoff_base_s * (2.0 ** (attempt - 1)))
+
+    def start(self):
+        self.proc = self._spawn()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name='autodist-supervise-%s' % self.address)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            code = self.proc.wait()
+            if code == 0 or self._is_shutting_down():
+                return
+            if self._policy == 'exclude':
+                logging.warning(
+                    'Worker %s exited with code %s; policy=exclude '
+                    'leaves recovery to the surviving peers (they '
+                    'fence its generation and shrink the gate '
+                    'membership)', self.address, code)
+                return
+            if self._policy == 'restart' and \
+                    self.restarts < self._max_restarts:
+                self.restarts += 1
+                delay = self.backoff_s(self.restarts)
+                logging.warning(
+                    'Worker %s exited with code %s; supervised restart '
+                    '%d/%d in %.1fs', self.address, code,
+                    self.restarts, self._max_restarts, delay)
+                self._sleep(delay)
+                # a shutdown that began during the backoff (Ctrl-C,
+                # clean teardown) must not be followed by a respawn
+                # nobody will ever terminate — and a fence failure
+                # against an already-torn-down coord service is not a
+                # reason to hard-abort the chief
+                if self._is_shutting_down():
+                    return
+                try:
+                    if self._fence is not None:
+                        self._fence()
+                except Exception as e:  # noqa: BLE001 - retried below
+                    if self._is_shutting_down():
+                        return
+                    # an unfenced respawn is still refused — but a
+                    # transient fence failure (network blip to one PS
+                    # endpoint, the dead worker's co-hosted endpoint
+                    # rebooting) burns ONE restart attempt and retries
+                    # under the growing backoff instead of hard-killing
+                    # the whole chief on the first miss
+                    logging.warning(
+                        'cannot fence dead worker %s (%s: %s); '
+                        'refusing an unfenced respawn — retrying the '
+                        'fence (attempt %d/%d)', self.address,
+                        type(e).__name__, e, self.restarts,
+                        self._max_restarts)
+                    continue
+                try:
+                    with self._spawn_lock:
+                        if self._is_shutting_down():
+                            return
+                        self.proc = self._spawn()
+                except Exception as e:  # noqa: BLE001 - abort below
+                    logging.error('respawn of worker %s failed: %s: %s',
+                                  self.address, type(e).__name__, e)
+                    self._on_give_up(code)
+                    return
+                continue
+            if self._policy == 'restart':
+                logging.error(
+                    'Worker %s exhausted %d supervised restarts; '
+                    'marking it permanently failed', self.address,
+                    self._max_restarts)
+                try:
+                    if self._mark_failed is not None:
+                        self._mark_failed()
+                except Exception as e:  # noqa: BLE001 - best effort
+                    logging.warning(
+                        'could not mark worker %s failed on the coord '
+                        'service: %s: %s', self.address,
+                        type(e).__name__, e)
+            else:
+                logging.error(
+                    'Worker %s exited with code %s; aborting chief',
+                    self.address, code)
+            self._on_give_up(code)
+            return
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def terminate(self):
+        with self._spawn_lock:
+            if self.proc is not None and self.proc.poll() is None:
+                self.proc.terminate()
 # AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
 # assignments ride the remote ssh command line, which is world-readable
 # in `ps` on the worker host. The secret ships as a mode-0600 file
@@ -52,8 +209,7 @@ class Coordinator:
         self._resource_spec = resource_spec
         self._cluster = cluster
         self._shutting_down = False
-        self.threads = []
-        self.procs = []
+        self.supervisors = []
         self._token_path = ''
         # arm the XLA overlap flags BEFORE building worker envs: any
         # AllReduce node means bucketed gradient sync, and the flags
@@ -116,6 +272,24 @@ class Coordinator:
         return address if not (ssh_config and ssh_config.username) \
             else '%s@%s' % (ssh_config.username, address)
 
+    @staticmethod
+    def _run_remote(cmd, what, timeout_s=60.0, retries=1,
+                    retry_wait_s=1.0):
+        """Run one ssh/scp shipping command with a timeout and a single
+        retried attempt: a transient SSH hiccup (dropped handshake,
+        momentary DNS stall) must not abort the whole multi-host
+        launch, and a wedged transfer must not hang it forever."""
+        for attempt in range(retries + 1):
+            try:
+                subprocess.run(cmd, check=True, timeout=timeout_s)
+                return
+            except (subprocess.SubprocessError, OSError) as e:
+                if attempt >= retries:
+                    raise
+                logging.warning('%s failed (%s: %s); retrying in %.0fs',
+                                what, type(e).__name__, e, retry_wait_s)
+                time.sleep(retry_wait_s)
+
     def _copy_strategy(self, address, ssh_config):
         """Ship the serialized strategy file to a worker host (reference
         coordinator.py:56-64 SFTP copy).
@@ -134,8 +308,8 @@ class Coordinator:
             logging.info('[debug-remote] %s', ' '.join(scp_cmd))
             logging.info('[debug-remote] %s', ' '.join(mv_cmd))
             return
-        subprocess.run(scp_cmd, check=True)
-        subprocess.run(mv_cmd, check=True)
+        self._run_remote(scp_cmd, 'strategy scp to %s' % address)
+        self._run_remote(mv_cmd, 'strategy rename on %s' % address)
 
     def _copy_token(self, address, ssh_config):
         """Ship the coord-service shared secret to a worker host as a
@@ -164,15 +338,104 @@ class Coordinator:
             logging.info('[debug-remote] %s', ' '.join(scp_cmd))
             logging.info('[debug-remote] %s', ' '.join(mv_cmd))
             return
-        subprocess.run(scp_cmd, check=True)
-        subprocess.run(mv_cmd, check=True)
+        self._run_remote(scp_cmd, 'coord token scp to %s' % address)
+        self._run_remote(mv_cmd, 'coord token chmod+rename on %s'
+                         % address)
+
+    @property
+    def procs(self):
+        """Live worker processes (the current incarnation under each
+        supervisor — restarts swap the entries in place)."""
+        return [s.proc for s in self.supervisors if s.proc is not None]
+
+    def _coord_service_targets(self):
+        """Every service holding fence counters: the coord service plus
+        each PS endpoint (each keeps its OWN counter map, so a fence
+        bump must land on all of them). Local spellings are normalized
+        ('localhost' and friends -> 127.0.0.1) BEFORE the dedup: one
+        service named two ways would otherwise get a DOUBLE generation
+        bump per death, skewing its counter ahead of the generation the
+        replacement reads from the coord service — a later zombie's
+        writes would then pass that service's fence check."""
+        from autodist_tpu.runtime.cluster import is_local_address
+        from autodist_tpu.runtime.coord_client import ps_endpoints
+        addr = ENV.AUTODIST_COORD_SERVICE_ADDR.val or \
+            '%s:%d' % (self._resource_spec.chief, DEFAULT_COORD_PORT)
+        host, port = addr.rsplit(':', 1)
+
+        def norm(h, p):
+            return ('127.0.0.1' if is_local_address(h) else h, int(p))
+
+        targets = [norm(host, port)]
+        for h, p in ps_endpoints():
+            ep = norm(h, p)
+            if ep not in targets:
+                targets.append(ep)
+        return targets
+
+    def _fence_worker(self, process_id):
+        """Bump the dead worker's fencing generation everywhere it
+        could write; its replacement reads the new generation at
+        session init and joins under it."""
+        from autodist_tpu.runtime import coord_client as cc
+        # fence counters live OUTSIDE the run namespace (see
+        # Session._exclude_peer): they must survive the run-end purge
+        key = 'fence/%s/p%d' % (self._strategy.id, process_id)
+        for host, port in self._coord_service_targets():
+            client = cc.connect_with_retry((host, port), deadline_s=15.0)
+            try:
+                gen = client.incr(key, 1)
+            finally:
+                client.close()
+        logging.info('fenced dead worker p%d at generation %d',
+                     process_id, gen)
+
+    def _mark_worker_failed(self, process_id):
+        """Record permanent failure (restart budget exhausted) so peers
+        blocked on the staleness gate stop waiting and raise."""
+        from autodist_tpu.runtime import coord_client as cc
+        host, port = self._coord_service_targets()[0]
+        client = cc.connect_with_retry((host, port), deadline_s=15.0)
+        try:
+            client.set('%s/failed/p%d' % (self._strategy.id,
+                                          process_id), '1')
+        finally:
+            client.close()
+
+    @staticmethod
+    def _abort_chief(code):
+        os._exit(1)
+
+    def _effective_policy(self):
+        """The peer-failure policy workers are supervised under.
+        ``exclude``/``restart`` recovery lives in the loose-mode PS
+        plane (heartbeats + staleness gate + fenced rejoin); an SPMD
+        run has none of it — survivors would block in jax collectives
+        forever while the supervisor "leaves recovery to the peers" —
+        so a non-loose strategy keeps the fail-fast guarantee."""
+        policy = ENV.AUTODIST_PEER_FAILURE_POLICY.val
+        if policy == 'fail':
+            return policy
+        from autodist_tpu.autodist import AutoDist
+        if AutoDist._strategy_is_loose(self._strategy):
+            return policy
+        logging.warning(
+            'AUTODIST_PEER_FAILURE_POLICY=%s only applies to relaxed-'
+            'consistency (loose-mode) PS strategies; this strategy '
+            'runs SPMD, where a lost worker cannot be excluded or '
+            'rejoined — supervising workers under the fail policy '
+            'instead', policy)
+        return 'fail'
 
     def launch_clients(self):
-        """Re-run ``sys.argv`` on every non-chief replica host."""
+        """Re-run ``sys.argv`` on every non-chief replica host, each
+        under a policy-aware :class:`WorkerSupervisor`."""
         chief = self._resource_spec.chief
         workers = [n for n in self._resource_spec.nodes if n != chief]
         script = ' '.join(shlex.quote(a) for a in
                           [sys.executable] + sys.argv)
+        policy = self._effective_policy()
+        max_restarts = ENV.AUTODIST_MAX_WORKER_RESTARTS.val
         for i, address in enumerate(workers, start=1):
             ssh_config = self._resource_spec.ssh_config(address)
             self._copy_strategy(address, ssh_config)
@@ -190,34 +453,28 @@ class Coordinator:
             if ENV.AUTODIST_DEBUG_REMOTE.val:
                 logging.info('[debug-remote] %s', ' '.join(cmd))
                 continue
-            logging.info('Launching worker on %s', address)
-            proc = subprocess.Popen(cmd)
-            self.procs.append(proc)
-            t = threading.Thread(target=self._monitor,
-                                 args=(address, proc), daemon=True)
-            t.start()
-            self.threads.append(t)
+
+            def spawn(cmd=cmd, address=address):
+                logging.info('Launching worker on %s', address)
+                return subprocess.Popen(cmd)
+
+            self.supervisors.append(WorkerSupervisor(
+                address, spawn, policy=policy,
+                max_restarts=max_restarts,
+                fence=lambda pid=i: self._fence_worker(pid),
+                mark_failed=lambda pid=i: self._mark_worker_failed(pid),
+                on_give_up=self._abort_chief,
+                is_shutting_down=lambda: self._shutting_down).start())
         return self
 
-    def _monitor(self, address, proc):
-        """Fail fast: if any worker dies, kill the chief (reference
-        coordinator.py:98-110). Suppressed during intentional shutdown
-        so a clean exit's SIGTERMs don't read as worker failures."""
-        code = proc.wait()
-        if code != 0 and not self._shutting_down:
-            logging.error('Worker %s exited with code %s; aborting chief',
-                          address, code)
-            os._exit(1)
-
     def join(self):
-        for p in self.procs:
-            p.wait()
+        for s in self.supervisors:
+            s.join()
 
     def terminate(self):
         self._shutting_down = True
-        for p in self.procs:
-            if p.poll() is None:
-                p.terminate()
+        for s in self.supervisors:
+            s.terminate()
 
 
 def launch_cli(argv=None):

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import AXIS_DATA, DEFAULT_TRACE_DIR, ENV
+from autodist_tpu.const import (AXIS_DATA, DEFAULT_CHECKPOINT_DIR,
+                                DEFAULT_TRACE_DIR, ENV)
 from autodist_tpu.frontend import graph as fe
 from autodist_tpu.parallel.plan import ShardedGrad
 from autodist_tpu.utils import logging
@@ -146,6 +147,57 @@ class Session:
         # namespace coord-service keys by strategy id: a reused/leaked
         # service must not serve a previous run's vars or step counters
         self._ns = getattr(plan.strategy, 'id', 'default')
+        # -- elastic recovery (epoch-fenced membership) --------------------
+        # Peer-failure policy: what a survivor does when a peer misses
+        # heartbeats (fail = raise, exclude = fence + shrink membership,
+        # restart = wait for the coordinator-supervised replacement).
+        self._policy = ENV.AUTODIST_PEER_FAILURE_POLICY.val
+        self._min_workers = ENV.AUTODIST_MIN_WORKERS.val
+        self._excluded = set()      # peer keys dropped from membership
+        self._dead_since = {}       # restart policy: key -> detect time
+        self._epoch_seen = 0        # membership epoch (coord counter)
+        self._generation = 0        # this worker's fencing generation
+        self._fence_key = ''
+        self._rejoining = False
+        self._health = {'policy': self._policy, 'missed_beats': 0,
+                        'epoch_bumps': 0, 'exclusions': [],
+                        'rejoins': [], 'recovery_wall_s': [],
+                        'auto_checkpoints': 0}
+        if self._loose:
+            # every write this process makes rides connections bound to
+            # its fencing generation: once a survivor (or the restart
+            # supervisor) bumps our fence counter, the service rejects
+            # our writes — a zombie can never corrupt post-death state.
+            # fence/excluded counters live OUTSIDE the run namespace:
+            # the run-end purge (close) must not unfence a zombie or
+            # erase the exclusion record it may still need to observe
+            self._fence_key = 'fence/%s' % self._key(self._worker_name)
+            self._generation = coord.incr(self._fence_key, 0)
+            coord.fence(self._fence_key, self._generation)
+            # generation > 0 means a previous incarnation was declared
+            # dead: this process is its supervised replacement and must
+            # REJOIN (skip the init barrier nobody else attends, pull
+            # current params from the PS, resume at the published step)
+            self._rejoining = self._generation > 0
+            self._epoch_seen = coord.incr(self._key('epoch'), 0)
+            self._refresh_membership()
+            if self._rejoining:
+                self._step_count = coord.incr(
+                    self._key('step/') + self._worker_name, 0)
+                logging.info(
+                    'rejoining as %s under generation %d at published '
+                    'step %d (membership epoch %d)', self._worker_name,
+                    self._generation, self._step_count, self._epoch_seen)
+        # chief-side auto-checkpoint backstop: with restarts in play the
+        # PS state is authoritative, but a periodic chief snapshot
+        # bounds the blast radius of losing the PS itself
+        self._auto_ckpt = None
+        self._auto_ckpt_every = ENV.AUTODIST_AUTO_CHECKPOINT_EVERY.val
+        if self._loose and self._is_chief and self._auto_ckpt_every:
+            from autodist_tpu.checkpoint.saver import CheckpointManager
+            self._auto_ckpt = CheckpointManager(
+                os.path.join(DEFAULT_CHECKPOINT_DIR, 'auto', self._ns),
+                max_to_keep=2, async_save=True)
         # proxy variables (reference proxy_variable.py:46-190): a worker-
         # local cached copy serves reads. In SPMD programs reads are
         # already device-local, so the proxy is inherently satisfied; in
@@ -207,7 +259,7 @@ class Session:
                 # control-plane connection (CoordClient sockets are not
                 # thread-safe; the main thread keeps using self._coord)
                 self._pipe = cc.TransferPool(
-                    [lambda: cc.connect_with_retry(coord_addr)])
+                    [lambda: self._fenced_connect(coord_addr)])
         if self._proxy_vars and not self._loose:
             logging.info(
                 'local_proxy_variable on %d vars: subsumed by SPMD '
@@ -244,8 +296,11 @@ class Session:
                 # Connection failures are retried forever: a long XLA
                 # compile or data stall on OUR side must not permanently
                 # silence the beats and get us declared dead by peers.
+                # A FENCED rejection is different: we WERE declared dead
+                # and superseded — stop beating for good (a zombie must
+                # not look alive to anyone).
                 from autodist_tpu.runtime.coord_client import \
-                    connect_with_retry
+                    FencedWriteError, connect_with_retry
                 client = None
                 warned = False
                 try:
@@ -260,6 +315,15 @@ class Session:
                                 client = connect_with_retry(
                                     coord_addr, deadline_s=interval,
                                     op_timeout=min(10.0, interval))
+                                if self._fence_key:
+                                    client.fence(self._fence_key,
+                                                 self._generation)
+                            except FencedWriteError:
+                                logging.warning(
+                                    'heartbeat thread: this worker was '
+                                    'declared dead and fenced; beats '
+                                    'stop here')
+                                break
                             except Exception:  # noqa: BLE001 - advisory
                                 if not warned:
                                     warned = True
@@ -273,6 +337,12 @@ class Session:
                                 continue
                         try:
                             client.heartbeat(me)
+                        except FencedWriteError:
+                            logging.warning(
+                                'heartbeat thread: this worker was '
+                                'declared dead and fenced; beats stop '
+                                'here')
+                            break
                         except OSError:
                             try:
                                 client.close()
@@ -347,30 +417,163 @@ class Session:
         """Another worker's published completed-step counter (0 if none)."""
         return self._coord.incr(self._key('step/') + 'p%d' % process_id, 0)
 
+    def _active_workers(self):
+        """Current gate membership size (self-inclusive): the launch
+        quorum minus peers excluded under the ``exclude`` policy."""
+        return self._num_workers - len(self._excluded)
+
+    def _refresh_membership(self):
+        """Adopt exclusions recorded on the control plane. Membership
+        is DERIVED from per-worker excluded markers (atomic counters),
+        never a read-modify-write list, so two survivors excluding two
+        different peers concurrently cannot lose each other's update."""
+        for i in range(self._num_workers):
+            w = 'p%d' % i
+            wkey = self._key(w)
+            if wkey in self._excluded:
+                continue
+            if self._coord.incr('excluded/%s' % wkey, 0) > 0:
+                self._excluded.add(wkey)
+        if self._key(self._worker_name) in self._excluded:
+            raise RuntimeError(
+                'this worker (%s) was declared dead and excluded from '
+                'the run at epoch %d; its writes are fenced — exiting '
+                'instead of training into rejected pushes'
+                % (self._worker_name, self._epoch_seen))
+
+    def _exclude_peer(self, wkey, timeout):
+        """Epoch-fenced exclusion of a dead peer. Every detector fences
+        the zombie's writer generation FIRST — on every service it can
+        write to (each PS endpoint keeps its own fence counter) —
+        BEFORE the exclusion becomes observable anywhere: the moment
+        any process can see the marker, the zombie's writes must
+        already be rejected. Fencing is idempotent (any bump past the
+        bound generation fences; concurrent detectors just bump
+        further). Then exactly one survivor wins the atomic claim and
+        re-bounds the membership: it releases the dead worker's step
+        counter with the same ``1 << 30`` sentinel a clean close
+        publishes (deleting the key instead would let any later
+        delta-0 read resurrect it at zero and wedge every survivor's
+        gate forever) and bumps the membership epoch so every other
+        survivor adopts the shrunk quorum on its next liveness check.
+        The fence/excluded counters live OUTSIDE the run namespace
+        (``fence/<ns>/<w>``, ``excluded/<ns>/<w>``): they survive the
+        run-end purge, so a zombie stays fenced — and its exclusion
+        stays observable — after the survivors are gone."""
+        w = wkey.rsplit('/', 1)[-1]
+        if self._active_workers() - 1 < self._min_workers:
+            raise RuntimeError(
+                'worker %s missed heartbeats for > %.0fs but excluding '
+                'it would leave %d live workers, below '
+                'AUTODIST_MIN_WORKERS=%d — failing instead of shrinking'
+                % (w, timeout, self._active_workers() - 1,
+                   self._min_workers))
+        fkey = 'fence/%s' % wkey
+        self._pool.run([(ep, lambda c, k=fkey: c.incr(k, 1))
+                        for ep in range(len(self._pool))])
+        coord_addr = tuple(getattr(self._coord, 'address', ()) or ())
+        if coord_addr not in [tuple(a) for a in self._ps_addrs]:
+            self._coord.incr(fkey, 1)
+        claim = self._coord.incr('excluded/%s' % wkey, 1)
+        if claim == 1:
+            from autodist_tpu.runtime.coord_client import CLEAN_CLOSE_STEP
+            self._coord.publish_step(w, CLEAN_CLOSE_STEP,
+                                     prefix=self._key('step/'))
+            self._epoch_seen = self._coord.incr(self._key('epoch'), 1)
+            self._health['epoch_bumps'] += 1
+            logging.warning(
+                'declared peer %s dead (no heartbeat for > %.0fs): '
+                'generation fenced, excluded from membership — epoch '
+                '%d, %d active workers remain', w, timeout,
+                self._epoch_seen, self._active_workers() - 1)
+        else:
+            # another survivor won the claim; adopt its epoch
+            self._epoch_seen = self._coord.incr(self._key('epoch'), 0)
+        self._excluded.add(wkey)
+        self._health['exclusions'].append(
+            {'worker': w, 'epoch': self._epoch_seen})
+
     def _check_peers_alive(self):
-        """Fail fast while blocked on the staleness gate if a peer has
-        stopped heartbeating (reference coordinator.py:98-110 monitors
-        hard-exit the chief when a worker dies; here the signal is a
-        stalled coord-service beat counter, judged on this process's
-        own clock — immune to cross-host clock skew)."""
+        """Liveness + recovery policy while blocked on the staleness
+        gate (reference coordinator.py:98-110 monitors hard-exit the
+        chief when a worker dies; here the signal is a stalled
+        coord-service beat counter, judged on this process's own clock
+        — immune to cross-host clock skew). Under the default ``fail``
+        policy a dead peer raises; ``exclude`` shrinks the membership
+        (epoch bump + generation fencing); ``restart`` keeps waiting
+        for the coordinator-supervised replacement."""
+        import time as _time
         timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
         if not timeout:
             return
         # belt and braces alongside the background beater: a waiter is
         # trivially alive, refresh our beat on every gate slice too
         self._coord.heartbeat(self._key(self._worker_name))
-        dead = self._coord.dead_workers(self._hb_peers, timeout,
-                                        self._hb_seen)
+        # adopt membership changes other survivors fenced in
+        epoch = self._coord.incr(self._key('epoch'), 0)
+        if epoch != self._epoch_seen:
+            self._health['epoch_bumps'] += epoch - self._epoch_seen
+            self._epoch_seen = epoch
+            self._refresh_membership()
+            logging.warning('membership epoch advanced to %d: %d '
+                            'active workers', epoch,
+                            self._active_workers())
+        peers = [w for w in self._hb_peers if w not in self._excluded]
+        dead = self._coord.dead_workers(peers, timeout, self._hb_seen)
         if dead:
             # a peer that closed its session cleanly stops beating but
             # is NOT a crash: it published a done key (Session.close)
             dead = [w for w in dead
                     if self._coord.get('done/%s' % w) is None]
-        if dead:
-            raise RuntimeError(
-                'worker(s) %s missed heartbeats for > %.0fs while this '
-                'process waited on the staleness gate — failing fast '
-                'instead of hanging' % (sorted(dead), timeout))
+        # restart policy: a peer beating again after a declared death
+        # is its reborn incarnation — record the recovery wall time
+        for w in list(self._dead_since):
+            if w not in dead:
+                wall = _time.time() - self._dead_since.pop(w)
+                self._health['rejoins'].append(w.rsplit('/', 1)[-1])
+                self._health['recovery_wall_s'].append(round(wall, 3))
+                logging.info('peer %s is heartbeating again %.1fs '
+                             'after its death was detected', w, wall)
+        if not dead:
+            return
+        self._health['missed_beats'] += \
+            sum(1 for w in dead if w not in self._dead_since)
+        if self._policy == 'exclude':
+            for w in dead:
+                self._exclude_peer(w, timeout)
+            return
+        if self._policy == 'restart':
+            now = _time.time()
+            wait_cap = ENV.AUTODIST_RESTART_WAIT_S.val
+            for w in dead:
+                short = w.rsplit('/', 1)[-1]
+                if self._coord.get(
+                        self._key('failed/%s' % short)) is not None:
+                    raise RuntimeError(
+                        'worker %s exhausted its supervised restarts '
+                        '(AUTODIST_MAX_WORKER_RESTARTS) and was marked '
+                        'permanently failed — aborting' % short)
+                if w not in self._dead_since:
+                    self._dead_since[w] = now
+                    logging.warning(
+                        'peer %s missed heartbeats for > %.0fs; '
+                        'policy=restart: waiting for its supervised '
+                        'replacement', w, timeout)
+                elif now - self._dead_since[w] > wait_cap:
+                    # backstop for a silently dead supervisor: the
+                    # normal abort is the failed marker above
+                    raise RuntimeError(
+                        'worker %s has been dead for %.0fs with no '
+                        'supervised replacement and no failed marker '
+                        '(AUTODIST_RESTART_WAIT_S=%.0f) — aborting'
+                        % (short, now - self._dead_since[w], wait_cap))
+            # truthy = recovery in flight: the staleness gate re-arms
+            # its window instead of timing out under the supervisor
+            return True
+        raise RuntimeError(
+            'worker(s) %s missed heartbeats for > %.0fs while this '
+            'process waited on the staleness gate — failing fast '
+            'instead of hanging' % (sorted(dead), timeout))
 
     # -- loose-mode PS endpoint placement ----------------------------------
     def _init_ps_endpoints(self):
@@ -412,8 +615,18 @@ class Session:
             self._ps_addrs = [tuple(getattr(self._coord, 'address',
                                             (None, 0)))]
         self._pool = cc.TransferPool(
-            [lambda addr=addr: cc.connect_with_retry(addr)
+            [lambda addr=addr: self._fenced_connect(addr)
              for addr in self._ps_addrs])
+
+    def _fenced_connect(self, addr):
+        """Dial a data/control-plane connection bound to this worker's
+        fencing generation: every write it carries is rejected by the
+        service once we are declared dead and superseded."""
+        from autodist_tpu.runtime import coord_client as cc
+        client = cc.connect_with_retry(addr)
+        if self._fence_key:
+            client.fence(self._fence_key, self._generation)
+        return client
 
     @staticmethod
     def _stable_idx(name, n):
@@ -477,6 +690,44 @@ class Session:
                      pc.shard_shapes(var.shape)]
         for ep, n in zip(idxs, sizes):
             self._ps_ep_bytes[ep] += self._wire_nbytes(n)
+
+    def _auto_checkpoint(self):
+        """Chief-side recovery backstop: snapshot the post-step variable
+        state every ``AUTODIST_AUTO_CHECKPOINT_EVERY`` train steps
+        (async save — the device->host copy is the only on-path cost).
+        Never fatal: the backstop degrading must not kill the training
+        it exists to protect."""
+        try:
+            tree = {name: self._local_value(name)
+                    for name in self._graph_item.graph.variables}
+            self._auto_ckpt.save(self._step_count, tree)
+            self._health['auto_checkpoints'] += 1
+        except Exception as e:  # noqa: BLE001 - backstop, not the run
+            logging.warning('auto-checkpoint at step %d failed: %s: %s',
+                            self._step_count, type(e).__name__, e)
+
+    @property
+    def health_stats(self):
+        """Elastic-recovery observability (feeds
+        :func:`autodist_tpu.utils.profiling.health_report`): the peer
+        failure policy, this worker's fencing generation, the current
+        membership epoch, declared-dead counts, exclusions, observed
+        rejoins with their recovery wall times, and the auto-checkpoint
+        count. Empty for SPMD (non-loose) sessions: none of the
+        recovery machinery runs there, and reporting its zero-state as
+        if it did would be misleading."""
+        if not self._loose:
+            return {}
+        out = dict(self._health)
+        out.update(
+            epoch=self._epoch_seen,
+            generation=self._generation,
+            rejoining=self._rejoining,
+            num_workers=self._num_workers,
+            active_workers=self._num_workers - len(self._excluded),
+            excluded=sorted(w.rsplit('/', 1)[-1]
+                            for w in self._excluded))
+        return out
 
     @property
     def ps_stats(self):
@@ -566,17 +817,32 @@ class Session:
             # chief seeds the authoritative PS copies across endpoints,
             # one tensor per shard for partitioned variables — one
             # pipelined vmset batch per endpoint (one round trip each
-            # instead of one per variable/shard/chunk)
-            if self._is_chief:
+            # instead of one per variable/shard/chunk). A REJOINING
+            # incarnation must never re-seed: the PS holds the trained
+            # state its replacement exists to pick up.
+            if self._is_chief and not self._rejoining:
                 self._store_var_parts(
                     {name: v.init_value
                      for name, v in variables.items()})
             # heartbeat baseline BEFORE the barrier: once any gate runs,
             # every peer has a timestamp (a missing one reads as dead)
             self._coord.heartbeat(self._key(self._worker_name))
-            self._coord.barrier(self._key('session/init'),
-                                self._num_workers, timeout_s=120.0)
-            if not self._is_chief:
+            if not self._rejoining:
+                self._coord.barrier(self._key('session/init'),
+                                    self._num_workers, timeout_s=120.0)
+                if self._is_chief:
+                    # replacements key off this marker: only skip the
+                    # init rendezvous once it actually completed
+                    self._coord.set(self._key('session/init-done'), '1')
+            elif self._coord.get(
+                    self._key('session/init-done')) is None:
+                # the prior incarnation died BEFORE its cohort's init
+                # rendezvous completed: the replacement must fill the
+                # dead worker's barrier slot, or the original cohort
+                # blocks forever on a party that no longer exists
+                self._coord.barrier(self._key('session/init'),
+                                    self._num_workers, timeout_s=120.0)
+            if not self._is_chief or self._rejoining:
                 served_map, _ = self._fetch_var_parts(list(variables))
                 for name, parts in served_map.items():
                     var = variables[name]
@@ -711,9 +977,12 @@ class Session:
             # 30-35); any sync var imposes its (tightest) bound.
             self._coord.heartbeat(self._key(self._worker_name))
             if is_train and self._plan.gate_enabled:
+                # membership is a CALLABLE: policy=exclude can shrink
+                # the quorum while we are blocked inside this gate, and
+                # the wait must re-bound against the new epoch's count
                 self._coord.staleness_gate(
                     self._step_count + 1, self._plan.gate_staleness,
-                    self._num_workers, prefix=self._key('step/'),
+                    self._active_workers, prefix=self._key('step/'),
                     failure_check=self._check_peers_alive)
                 # the gate guarantees every peer completed >= step -
                 # staleness; a prefetch taken while some peer was still
@@ -765,6 +1034,9 @@ class Session:
                         _time.perf_counter() - t_step
                     self._ps_phase['train_steps'] += 1
                 self._dispatch_push(shared_spec, outs, pulled)
+                if self._auto_ckpt is not None and \
+                        self._step_count % self._auto_ckpt_every == 0:
+                    self._auto_checkpoint()
 
         split_sizes = {v.shape[0] // self._plan.local_replicas
                        for v, s in zip(feed_vals, split_flags) if s}
@@ -1248,18 +1520,33 @@ class Session:
             # step counter past any reachable gate bound so a peer
             # blocked on the staleness window is released
             try:
+                from autodist_tpu.runtime.coord_client import \
+                    CLEAN_CLOSE_STEP
                 self._coord.set(
                     'done/%s' % self._key(self._worker_name), '1')
-                self._coord.publish_step(self._worker_name, 1 << 30,
+                self._coord.publish_step(self._worker_name,
+                                         CLEAN_CLOSE_STEP,
                                          prefix=self._key('step/'))
                 # run-end cleanup (ADVICE r3): the LAST worker out
                 # purges the run's namespace from the coord service and
                 # every PS endpoint — a reused long-lived endpoint must
                 # not accumulate dead runs' multi-hundred-MB tensors.
                 # The atomic INCR makes exactly one process the purger,
-                # and only after every peer has closed.
+                # and only after every peer has closed. Excluded
+                # (fenced) peers can never increment this counter, so
+                # the quorum is the ACTIVE membership — else a run that
+                # excluded a dead worker would leak its namespace.
+                # Adopt membership changes this process may never have
+                # observed (it finished its last gated step before the
+                # excluder's epoch bump): a closer counting a stale,
+                # larger quorum would strand the 'closed' counter below
+                # every threshold and silently skip the purge.
+                epoch = self._coord.incr(self._key('epoch'), 0)
+                if epoch != self._epoch_seen:
+                    self._epoch_seen = epoch
+                    self._refresh_membership()
                 closed = self._coord.incr(self._key('closed'), 1)
-                if closed >= self._num_workers:
+                if closed >= self._active_workers():
                     purged = sum(self._pool.run(
                         [(ep, lambda c: c.delete_namespace(
                             self._ns + '/'))
@@ -1282,6 +1569,12 @@ class Session:
                      getattr(self, '_pool', None)):
             if pool is not None:
                 pool.close()
+        if getattr(self, '_auto_ckpt', None) is not None:
+            try:
+                self._auto_ckpt.close()   # drain the in-flight save
+            except Exception as e:  # noqa: BLE001 - backstop teardown
+                logging.warning('auto-checkpoint drain failed in '
+                                'close(): %s: %s', type(e).__name__, e)
         if drain_err is not None:
             raise drain_err
 

@@ -231,6 +231,73 @@ def ps_overlap_report(ps_stats):
     }
 
 
+def health_report(health_stats, faultline=None):
+    """Recovery observability: one record per run of everything the
+    elastic-recovery machinery did — so every recovery is auditable,
+    not anecdotal.
+
+    ``health_stats`` is :attr:`Session.health_stats` (policy, fencing
+    generation, membership epoch, missed beats, exclusions, rejoins,
+    recovery wall times, auto-checkpoints). ``faultline`` is an armed
+    :class:`~autodist_tpu.utils.faultline.FaultLine` (or its ``events``
+    list) whose injected faults are attached, so a chaos run's report
+    pairs "what was injected" with "what the runtime did about it".
+    Connection-retry counts come from the process-wide
+    ``coord_client.RETRY_STATS``.
+
+    Returns ``{}`` when the session never ran in loose mode (no
+    recovery machinery to report on).
+    """
+    from autodist_tpu.runtime.coord_client import RETRY_STATS
+    hs = dict(health_stats or {})
+    if not hs:
+        return {}
+    events = faultline if isinstance(faultline, (list, tuple)) \
+        else getattr(faultline, 'events', [])
+    recovery = list(hs.get('recovery_wall_s', ()))
+    return {
+        'policy': hs.get('policy', 'fail'),
+        'generation': hs.get('generation', 0),
+        'epoch': hs.get('epoch', 0),
+        'epoch_bumps': hs.get('epoch_bumps', 0),
+        'num_workers': hs.get('num_workers', 1),
+        'active_workers': hs.get('active_workers',
+                                 hs.get('num_workers', 1)),
+        'missed_beats': hs.get('missed_beats', 0),
+        'exclusions': list(hs.get('exclusions', ())),
+        'rejoins': list(hs.get('rejoins', ())),
+        'restarts_observed': len(hs.get('rejoins', ())),
+        'recovery_wall_s': recovery,
+        'max_recovery_wall_s': max(recovery) if recovery else 0.0,
+        'auto_checkpoints': hs.get('auto_checkpoints', 0),
+        'connect_retries': RETRY_STATS['connect_retries'],
+        'injected_faults': [
+            {'kind': e['kind'], 'line': e.get('line', '')}
+            for e in events],
+    }
+
+
+def format_health(report):
+    """Human-readable rendering of :func:`health_report`."""
+    if not report:
+        return '(no loose-mode session: nothing to report)'
+    lines = ['policy=%s generation=%d epoch=%d  membership %d/%d'
+             % (report['policy'], report['generation'], report['epoch'],
+                report['active_workers'], report['num_workers'])]
+    lines.append('  missed beats: %d   connect retries: %d   '
+                 'auto-checkpoints: %d'
+                 % (report['missed_beats'], report['connect_retries'],
+                    report['auto_checkpoints']))
+    for ex in report['exclusions']:
+        lines.append('  excluded %s at epoch %d'
+                     % (ex.get('worker'), ex.get('epoch', -1)))
+    for w, s in zip(report['rejoins'], report['recovery_wall_s']):
+        lines.append('  %s rejoined after %.1fs' % (w, s))
+    for f in report['injected_faults']:
+        lines.append('  injected: %s (%s)' % (f['kind'], f['line']))
+    return '\n'.join(lines)
+
+
 def format_ps_overlap(report):
     """Human-readable rendering of :func:`ps_overlap_report`."""
     if not report:

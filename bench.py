@@ -679,6 +679,173 @@ def _bench_ps_pipeline_inner(steps):
     }
 
 
+def bench_recovery(steps=6, kill_at=2):
+    """Elastic-recovery A/B (ISSUE 4 acceptance).
+
+    Runs the SAME chief workload twice against the loose-mode control
+    plane with a simulated peer worker (own coord client: joins the
+    init barrier, heartbeats, publishes steps): once with a healthy
+    peer (the uninterrupted baseline) and once with the peer dying
+    silently at step ``kill_at`` under
+    ``AUTODIST_PEER_FAILURE_POLICY=exclude``. Records steps blocked at
+    the staleness gate, the recovery wall time (death detection ->
+    exclusion -> training resumed), whether the zombie's post-death
+    push was rejected by generation fencing, the final-state divergence
+    vs the uninterrupted run, and the full ``profiling.health_report``.
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_recovery_inner(steps, kill_at)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _recovery_run(port, steps, kill_at, staleness=1, dim=48):
+    """One chief run beside a simulated peer (``kill_at=None`` = the
+    peer stays healthy to the end). Returns (per-step walls, final W,
+    health report dict, zombie_push_rejected or None)."""
+    import threading
+
+    import autodist_tpu as ad
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   FencedWriteError)
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+    from autodist_tpu.utils.profiling import health_report
+
+    with single_process_loose_env(port, depth=1):
+        # the session must ALSO see 2 workers (the simulated peer is a
+        # real barrier/gate party), unlike the ps-pipeline harness
+        autodist = ad.AutoDist(
+            resource_info={'nodes': [
+                {'address': 'localhost', 'gpus': [0], 'chief': True,
+                 'network_bandwidth': 100}]},
+            strategy_builder=ad.strategy.PS(staleness=staleness))
+        rng = np.random.RandomState(0)
+        W0 = rng.randn(dim, 3).astype(np.float32)
+        feed = rng.randn(8, dim).astype(np.float32)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None, dim], dtype=np.float32,
+                               name='x')
+            W = ad.Variable(W0, name='W')
+            loss = ad.ops.reduce_mean(
+                ad.ops.square(ad.ops.matmul(x, W)))
+            train_op = ad.optimizers.SGD(0.1).minimize(loss, [W])
+            autodist._build()
+            ns = autodist._transformed[0].id
+            peer_ready = threading.Event()
+            zombie = {}
+
+            def peer():
+                c = CoordClient(('127.0.0.1', port))
+                gen = c.incr('fence/%s/p1' % ns, 0)
+                c.fence('fence/%s/p1' % ns, gen)
+                zombie['client'] = c
+                c.heartbeat('%s/p1' % ns)
+                peer_ready.set()
+                c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+                last = steps if kill_at is None else kill_at
+                for s in range(1, last + 1):
+                    c.heartbeat('%s/p1' % ns)
+                    c.publish_step('p1', s, prefix='%s/step/' % ns)
+                    time.sleep(0.05)
+                if kill_at is None:
+                    # clean finish: done marker + release sentinel,
+                    # exactly like Session.close
+                    c.set('done/%s/p1' % ns, '1')
+                    c.publish_step('p1', 1 << 30,
+                                   prefix='%s/step/' % ns)
+                # else: silence — a crash leaves no marker
+
+            t = threading.Thread(target=peer, daemon=True)
+            t.start()
+            peer_ready.wait(30.0)
+            sess = autodist.create_distributed_session()
+            walls = []
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                sess.run(train_op, {x: feed})
+                walls.append(time.perf_counter() - t0)
+            w_final = sess.get_variable_value('W')
+            rejected = None
+            if kill_at is not None:
+                # the zombie pushes AFTER its death was declared: the
+                # generation fence must reject it (checked before
+                # close(), whose run-end purge clears the namespace)
+                try:
+                    zombie['client'].vadd('%s/var/W' % ns,
+                                          np.ones((dim, 3), np.float32))
+                    rejected = False
+                except FencedWriteError:
+                    rejected = True
+            report = health_report(sess.health_stats)
+            sess.close()
+            t.join(timeout=10.0)
+        return walls, w_final, report, rejected
+
+
+def _bench_recovery_inner(steps, kill_at):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    hb_timeout = 1.5
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    saved = {k: os.environ.get(k)
+             for k in ('AUTODIST_PEER_FAILURE_POLICY',
+                       'AUTODIST_HEARTBEAT_TIMEOUT')}
+    os.environ['AUTODIST_PEER_FAILURE_POLICY'] = 'exclude'
+    os.environ['AUTODIST_HEARTBEAT_TIMEOUT'] = str(hb_timeout)
+    try:
+        base_walls, w_base, _, _ = _recovery_run(port, steps, None)
+        walls, w_fault, report, rejected = _recovery_run(
+            port, steps, kill_at)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+    # a step blocked at the gate waited at least ~the heartbeat window
+    blocked = [i + 1 for i, w in enumerate(walls) if w > hb_timeout / 2]
+    # on a badly loaded host EVERY step can classify as blocked: the
+    # unblocked mean must degrade to 0.0, not np.mean([]) = NaN, which
+    # json.dumps renders as bare NaN and invalidates the whole record
+    unblocked = [w for i, w in enumerate(walls) if i + 1 not in blocked]
+    return {
+        'policy': 'exclude',
+        'steps': steps,
+        'kill_at': kill_at,
+        'steps_blocked': len(blocked),
+        'recovery_wall_s': round(max(walls), 3) if blocked else 0.0,
+        'mean_step_wall_s': round(float(np.mean(unblocked)), 5)
+        if unblocked else 0.0,
+        'baseline_mean_step_wall_s': round(float(np.mean(base_walls)),
+                                           5),
+        'zombie_push_rejected': rejected,
+        # the simulated peer pushes no deltas, so the exclude policy
+        # must leave the survivor's math untouched: expected 0.0
+        'state_max_abs_diff': float(np.abs(w_fault - w_base).max()),
+        'excluded': report.get('exclusions', []),
+        'epoch': report.get('epoch', 0),
+        'missed_beats': report.get('missed_beats', 0),
+        'max_recovery_wall_s': report.get('max_recovery_wall_s', 0.0),
+    }
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -796,6 +963,7 @@ def main():
         result['extra']['grad_sync'] = bench_grad_sync()
         result['extra']['simulator'] = bench_simulator()
         result['extra']['ps_pipeline'] = bench_ps_pipeline()
+        result['extra']['recovery'] = bench_recovery()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -810,6 +978,7 @@ def main():
     grad_sync = bench_grad_sync()
     simulator = bench_simulator()
     ps_pipeline = bench_ps_pipeline()
+    recovery = bench_recovery()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -826,6 +995,7 @@ def main():
                 'grad_sync': grad_sync,
                 'simulator': simulator,
                 'ps_pipeline': ps_pipeline,
+                'recovery': recovery,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -877,7 +1047,8 @@ def main():
                       'cpu_fallback': fell_back,
                       'grad_sync': grad_sync,
                       'simulator': simulator,
-                      'ps_pipeline': ps_pipeline},
+                      'ps_pipeline': ps_pipeline,
+                      'recovery': recovery},
         }
     print(json.dumps(result))
 

@@ -1,0 +1,327 @@
+"""WorkerSupervisor restart loop + coordinator launch satellites
+(ISSUE 4): policy-aware supervision with injectable process/fence/
+sleep hooks (no ssh needed), capped exponential backoff, fence-before-
+respawn ordering, permanent-failure marking; ssh/scp shipping timeout +
+retry; Cluster.terminate logging its swallowed shutdown error."""
+import subprocess
+import threading
+
+import pytest
+
+from autodist_tpu.runtime.coordinator import Coordinator, WorkerSupervisor
+
+
+class _FakeProc:
+    """Popen-shaped: wait() blocks until a return code is delivered."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._rc = None
+
+    def exit(self, rc):
+        self._rc = rc
+        self._done.set()
+
+    def wait(self):
+        self._done.wait(30.0)
+        return self._rc
+
+    def poll(self):
+        return self._rc if self._done.is_set() else None
+
+    def terminate(self):
+        self.exit(-15)
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+        self.procs = []
+        self.gave_up = []
+
+    def spawn(self):
+        self.events.append('spawn')
+        p = _FakeProc()
+        self.procs.append(p)
+        return p
+
+    def fence(self):
+        self.events.append('fence')
+
+    def mark_failed(self):
+        self.events.append('mark_failed')
+
+    def give_up(self, code):
+        self.events.append('give_up')
+        self.gave_up.append(code)
+
+    def sleep(self, s):
+        self.events.append('sleep %.2f' % s)
+
+
+def _sup(rec, policy, max_restarts=2, **kw):
+    return WorkerSupervisor(
+        'w1', rec.spawn, policy=policy, max_restarts=max_restarts,
+        fence=rec.fence, mark_failed=rec.mark_failed,
+        on_give_up=rec.give_up, sleep=rec.sleep, **kw)
+
+
+def test_restart_policy_fences_before_each_respawn():
+    """Crash -> backoff -> FENCE -> respawn, in that order; a clean
+    exit ends supervision without a restart."""
+    rec = _Recorder()
+    sup = _sup(rec, 'restart').start()
+    rec.procs[0].exit(137)
+    for _ in range(500):
+        if len(rec.procs) == 2:
+            break
+        threading.Event().wait(0.01)
+    assert len(rec.procs) == 2 and sup.restarts == 1
+    rec.procs[1].exit(0)           # reborn finishes cleanly
+    sup.join(timeout=10.0)
+    assert rec.events == ['spawn', 'sleep 0.50', 'fence', 'spawn']
+    assert rec.gave_up == []
+
+
+def test_restart_backoff_is_capped_exponential():
+    rec = _Recorder()
+    sup = _sup(rec, 'restart', max_restarts=8, backoff_base_s=1.0,
+               backoff_cap_s=10.0)
+    assert [sup.backoff_s(a) for a in range(1, 7)] == \
+        [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]
+
+
+def test_restart_exhaustion_marks_failed_then_gives_up():
+    rec = _Recorder()
+    sup = _sup(rec, 'restart', max_restarts=1).start()
+    rec.procs[0].exit(9)
+    for _ in range(500):
+        if len(rec.procs) == 2:
+            break
+        threading.Event().wait(0.01)
+    rec.procs[1].exit(9)           # the restart crashes too
+    sup.join(timeout=10.0)
+    assert rec.events[-2:] == ['mark_failed', 'give_up']
+    assert rec.gave_up == [9]
+    assert sup.restarts == 1
+
+
+def test_fence_failure_refuses_unfenced_respawn():
+    """If the dead generation cannot be fenced, respawning would risk a
+    live zombie corrupting state — the supervisor NEVER respawns
+    unfenced, but a fence failure burns one backoff attempt and is
+    retried (a transient RPC miss must not hard-abort the chief);
+    only a persistent failure exhausts the budget and gives up."""
+    rec = _Recorder()
+
+    def bad_fence():
+        rec.events.append('fence')
+        raise OSError('coord service unreachable')
+
+    sup = WorkerSupervisor('w1', rec.spawn, policy='restart',
+                           max_restarts=3, fence=bad_fence,
+                           on_give_up=rec.give_up, sleep=rec.sleep)
+    sup.start()
+    rec.procs[0].exit(1)
+    sup.join(timeout=10.0)
+    # one fence attempt per restart slot, growing backoff, then give up
+    assert rec.events == ['spawn', 'sleep 0.50', 'fence',
+                          'sleep 1.00', 'fence', 'sleep 2.00', 'fence',
+                          'give_up']
+    assert len(rec.procs) == 1     # never respawned
+    assert rec.gave_up == [1]
+
+
+def test_fence_recovers_after_transient_failure():
+    """A fence that fails once then succeeds costs one restart slot
+    and the respawn proceeds fenced."""
+    rec = _Recorder()
+    calls = {'n': 0}
+
+    def flaky_fence():
+        calls['n'] += 1
+        rec.events.append('fence')
+        if calls['n'] == 1:
+            raise OSError('transient blip')
+
+    sup = WorkerSupervisor('w1', rec.spawn, policy='restart',
+                           max_restarts=3, fence=flaky_fence,
+                           on_give_up=rec.give_up, sleep=rec.sleep)
+    sup.start()
+    rec.procs[0].exit(1)
+    for _ in range(500):
+        if len(rec.procs) == 2:
+            break
+        threading.Event().wait(0.01)
+    assert len(rec.procs) == 2     # respawned after the fence landed
+    rec.procs[1].exit(0)
+    sup.join(timeout=10.0)
+    assert rec.events == ['spawn', 'sleep 0.50', 'fence',
+                          'sleep 1.00', 'fence', 'spawn']
+    assert rec.gave_up == []
+
+
+def test_effective_policy_forces_fail_for_spmd(monkeypatch):
+    """exclude/restart only exist in the loose-mode PS plane: an SPMD
+    strategy has no heartbeats or staleness gate, so supervising its
+    workers under exclude would hang survivors in collectives forever —
+    the coordinator falls back to fail-fast supervision."""
+    from autodist_tpu.strategy.base import (AllReduceSynchronizer,
+                                            PSSynchronizer, Strategy,
+                                            StrategyNode)
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
+    spmd = Strategy(strategy_id='spmd-test')
+    spmd.node_config = [
+        StrategyNode(var_name='w',
+                     synchronizer=AllReduceSynchronizer())]
+    loose = Strategy(strategy_id='loose-test')
+    loose.node_config = [
+        StrategyNode(var_name='w',
+                     synchronizer=PSSynchronizer(staleness=2))]
+    co = Coordinator.__new__(Coordinator)
+    co._strategy = spmd
+    assert co._effective_policy() == 'fail'
+    co._strategy = loose
+    assert co._effective_policy() == 'exclude'
+
+
+def test_fail_policy_gives_up_immediately():
+    rec = _Recorder()
+    sup = _sup(rec, 'fail').start()
+    rec.procs[0].exit(3)
+    sup.join(timeout=10.0)
+    assert rec.events == ['spawn', 'give_up'] and rec.gave_up == [3]
+
+
+def test_exclude_policy_leaves_recovery_to_peers():
+    rec = _Recorder()
+    sup = _sup(rec, 'exclude').start()
+    rec.procs[0].exit(3)
+    sup.join(timeout=10.0)
+    assert rec.events == ['spawn'] and rec.gave_up == []
+
+
+def test_shutdown_suppresses_restart_and_give_up():
+    rec = _Recorder()
+    shutting = threading.Event()
+    sup = WorkerSupervisor('w1', rec.spawn, policy='restart',
+                           max_restarts=3, fence=rec.fence,
+                           on_give_up=rec.give_up, sleep=rec.sleep,
+                           is_shutting_down=shutting.is_set)
+    sup.start()
+    shutting.set()
+    rec.procs[0].exit(-15)         # our own SIGTERM
+    sup.join(timeout=10.0)
+    assert rec.events == ['spawn'] and rec.gave_up == []
+
+
+def test_terminate_racing_respawn_kills_the_new_proc():
+    """terminate() landing while a respawn is in flight must not orphan
+    the freshly spawned worker: the spawn lock makes terminate wait for
+    the Popen to be assigned, then kill it (before the lock, terminate
+    polled the OLD exited proc and the respawn kept running forever)."""
+    rec = _Recorder()
+    shutting = threading.Event()
+    in_spawn = threading.Event()
+    release = threading.Event()
+
+    def gated_spawn():
+        p = rec.spawn()
+        if len(rec.procs) > 1:      # the respawn, held mid-Popen
+            in_spawn.set()
+            assert release.wait(10.0)
+        return p
+
+    sup = WorkerSupervisor('w1', gated_spawn, policy='restart',
+                           max_restarts=3, fence=rec.fence,
+                           on_give_up=rec.give_up,
+                           sleep=lambda s: None,
+                           is_shutting_down=shutting.is_set)
+    sup.start()
+    rec.procs[0].exit(1)            # crash -> supervised respawn
+    assert in_spawn.wait(10.0)      # supervisor holds the spawn lock
+    shutting.set()                  # Ctrl-C lands mid-respawn
+    t = threading.Thread(target=sup.terminate)
+    t.start()
+    release.set()                   # Popen completes, lock releases
+    t.join(10.0)
+    sup.join(timeout=10.0)
+    # the respawned proc was terminated, not orphaned
+    assert rec.procs[1].poll() == -15
+    assert rec.gave_up == []
+
+
+def test_coord_service_targets_dedup_local_spellings(monkeypatch):
+    """One service named two ways ('localhost' vs '127.0.0.1') is ONE
+    fence target: a double generation bump would skew that service's
+    counter ahead of the generation the replacement binds, letting the
+    NEXT zombie write through its fence."""
+    monkeypatch.setenv('AUTODIST_COORD_SERVICE_ADDR', 'localhost:5000')
+    monkeypatch.setenv('AUTODIST_PS_ENDPOINTS',
+                       '127.0.0.1:5000,127.0.0.1:5001')
+    co = Coordinator.__new__(Coordinator)
+    assert co._coord_service_targets() == [('127.0.0.1', 5000),
+                                           ('127.0.0.1', 5001)]
+
+
+# -- ssh/scp shipping satellite ----------------------------------------------
+
+def test_run_remote_retries_transient_failure_once(monkeypatch):
+    calls = []
+
+    def flaky(cmd, check, timeout):
+        calls.append((tuple(cmd), timeout))
+        if len(calls) == 1:
+            raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(subprocess, 'run', flaky)
+    monkeypatch.setattr('autodist_tpu.runtime.coordinator.time.sleep',
+                        lambda s: None)
+    Coordinator._run_remote(['scp', 'a', 'b'], 'test ship',
+                            timeout_s=7.0)
+    assert len(calls) == 2
+    assert all(t == 7.0 for _, t in calls)
+
+
+def test_run_remote_raises_after_retry_budget(monkeypatch):
+    def always_down(cmd, check, timeout):
+        raise subprocess.CalledProcessError(255, cmd)
+
+    monkeypatch.setattr(subprocess, 'run', always_down)
+    monkeypatch.setattr('autodist_tpu.runtime.coordinator.time.sleep',
+                        lambda s: None)
+    with pytest.raises(subprocess.CalledProcessError):
+        Coordinator._run_remote(['ssh', 'h', 'mv a b'], 'test ship')
+
+
+# -- cluster terminate satellite ---------------------------------------------
+
+def test_cluster_terminate_logs_swallowed_shutdown_error(monkeypatch,
+                                                         caplog):
+    import jax
+
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.resource_spec import ResourceSpec
+    spec = ResourceSpec(resource_info={'nodes': [
+        {'address': 'localhost', 'chief': True, 'gpus': [0],
+         'network_bandwidth': 10}]})
+    cluster = Cluster(spec)
+    cluster._started = True
+    monkeypatch.setenv('AUTODIST_NUM_PROCESSES', '2')
+
+    def boom():
+        raise RuntimeError('coordinator already gone')
+
+    monkeypatch.setattr(jax.distributed, 'shutdown', boom)
+    # the framework logger does not propagate to root: attach caplog's
+    # handler directly
+    from autodist_tpu.utils import logging as adlog
+    logger = adlog.get_logger()
+    logger.addHandler(caplog.handler)
+    try:
+        cluster.terminate()        # must not raise
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert not cluster._started
+    assert any('coordinator already gone' in r.getMessage()
+               for r in caplog.records)
