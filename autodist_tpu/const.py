@@ -77,6 +77,16 @@ def _min_int(name, raw, default, lo):
     return val
 
 
+def _frac(name, raw, default):
+    """Validated env parse: a float in [0, 1]."""
+    if raw is None or raw == '':
+        return default
+    val = float(raw)
+    if not 0.0 <= val <= 1.0:
+        raise ValueError('%s must be in [0, 1]; got %r' % (name, raw))
+    return val
+
+
 def _choice(name, raw, default, allowed):
     """Validated env parse: one of a closed set of strings."""
     if not raw:
@@ -127,6 +137,23 @@ class ENV(Enum):
     # ranged chunks (all B* updates are elementwise, so chunked
     # application is exact). 0 disables chunking.
     AUTODIST_PS_CHUNK_BYTES = (lambda v: int(v) if v else 64 << 20,)
+    # Row-sparse PS pushes (runtime/session.py _push_ps_deltas): a
+    # sparse-flagged variable's delta ships as indices+rows (BSADD)
+    # when its touched-row fraction is at or below this threshold —
+    # lossless, because the dropped rows' delta is exactly zero. Above
+    # it (or at 0.0, which disables the sparse plane) the dense BADD
+    # path is used. 0.5 default: beyond half the rows the index
+    # overhead outweighs the dense saving.
+    AUTODIST_SPARSE_PUSH_MAX_FRAC = \
+        (lambda v: _frac('AUTODIST_SPARSE_PUSH_MAX_FRAC', v, 0.5),)
+    # Row-sparse proxy refresh: after a sparse push, the local proxy
+    # cache refreshes only the pushed rows (BGETROWS); every Nth
+    # refresh of a variable falls back to a FULL fetch so rows other
+    # workers touched converge. 0 = never full-refresh (single-worker
+    # runs, where nobody else writes).
+    AUTODIST_SPARSE_FULL_REFRESH_EVERY = \
+        (lambda v: _min_int('AUTODIST_SPARSE_FULL_REFRESH_EVERY', v,
+                            64, lo=0),)
     # shared secret for the coord-service handshake: when set, the
     # service challenges every connection with a nonce and requires
     # HMAC-SHA256(token, nonce) before any command. Empty = open

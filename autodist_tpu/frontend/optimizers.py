@@ -39,6 +39,15 @@ class Optimizer:
     # None = PS-side apply unsupported, worker-local slots are used.
     ps_step_params = None
 
+    # Row-lazy update (LazyAdam/LazyMomentum): for sparse-read 2-D
+    # variables (embedding tables), apply the update ONLY to rows whose
+    # gradient is nonzero, keeping untouched rows — weights AND slot
+    # state — bit-stable. Stateful optimizers otherwise densify
+    # embedding deltas after the first step (decaying momentum / Adam
+    # moments update every row every step), which defeats the loose-
+    # mode row-sparse PS push (session._push_ps_deltas).
+    lazy_rows = False
+
     def __init__(self, tx, name=None, _capture=None):
         self.uid = 'opt_%d' % next(_UID)
         self.tx = tx
@@ -83,11 +92,36 @@ class Optimizer:
                 update, new_state = self.tx.update(grad.value, state, value)
             else:
                 value = env.var_values[var.name]
+                if self.lazy_rows and getattr(var, 'sparse_read',
+                                              False) and \
+                        getattr(grad, 'ndim', 0) == 2 and \
+                        tuple(grad.shape) == tuple(value.shape):
+                    new_values[var], slots[var.name] = \
+                        self._lazy_row_update(grad, state, value)
+                    continue
                 update, new_state = self.tx.update(grad, state, value)
             new_values[var] = value + update
             slots[var.name] = new_state
         env.opt_updates[self.uid] = slots
         return new_values
+
+    def _lazy_row_update(self, grad, state, value):
+        """Row-masked update: rows with an all-zero gradient keep their
+        weights and (same-shaped) slot state bit-identical; scalar
+        slots (e.g. the Adam step count) advance globally — the same
+        shared-t semantics as TF's LazyAdam."""
+        import jax
+        mask = jnp.any(grad != 0, axis=1, keepdims=True)
+        update, new_state = self.tx.update(grad, state, value)
+
+        def keep_untouched(new, old):
+            if hasattr(new, 'shape') and \
+                    tuple(new.shape) == tuple(value.shape):
+                return jnp.where(mask, new, old)
+            return new
+
+        return (jnp.where(mask, value + update, value),
+                jax.tree.map(keep_untouched, new_state, state))
 
 
 class SGD(Optimizer):
@@ -133,6 +167,40 @@ class Adam(Optimizer):
                 'rule': 'adam',
                 'params': [float(learning_rate), float(beta_1),
                            float(beta_2), float(epsilon)]}
+
+
+class LazyAdam(Optimizer):
+    """Adam that updates ONLY rows with nonzero gradient on sparse-read
+    (embedding) variables — untouched rows keep weights and moments
+    bit-stable, so loose-mode deltas stay row-sparse and the PS push
+    ships O(batch) rows instead of the whole table. Dense variables
+    get plain Adam. The step count (bias-correction t) is global, like
+    TF's ``tf.keras.optimizers.LazyAdam``. No PS-side shared-slot rule:
+    the service's BSTEP adam is dense by definition."""
+
+    lazy_rows = True
+
+    def __init__(self, learning_rate=0.001, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-7, name=None):
+        super().__init__(
+            optax.adam(learning_rate, b1=beta_1, b2=beta_2, eps=epsilon),
+            name, _capture=('LazyAdam', (learning_rate,),
+                            {'beta_1': beta_1, 'beta_2': beta_2,
+                             'epsilon': epsilon}))
+
+
+class LazyMomentum(Optimizer):
+    """Momentum SGD with row-lazy updates on sparse-read variables:
+    a row's velocity decays (and its weight moves) only on steps where
+    that row's gradient is nonzero. See :class:`LazyAdam`."""
+
+    lazy_rows = True
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, name=None):
+        super().__init__(
+            optax.sgd(learning_rate, momentum=momentum or None),
+            name, _capture=('LazyMomentum', (learning_rate,),
+                            {'momentum': momentum}))
 
 
 class AdamW(Optimizer):

@@ -33,6 +33,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from autodist_tpu.kernels.pallas_compat import \
+    CompilerParams as _CompilerParams
+
 
 def _interpret_default():
     return jax.default_backend() != 'tpu'
@@ -126,7 +129,7 @@ def _fwd_call(x2d, w, a, b, prologue_relu, want_stats, out_dtype,
             jax.ShapeDtypeStruct((1, c_out), jnp.float32),
             jax.ShapeDtypeStruct((1, c_out), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('arbitrary', 'arbitrary')),
         interpret=interpret,
     )(x2d, w.astype(x2d.dtype), a.reshape(1, c_in).astype(jnp.float32),

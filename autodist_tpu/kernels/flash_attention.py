@@ -25,6 +25,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from autodist_tpu.kernels.pallas_compat import \
+    CompilerParams as _CompilerParams
+
 NEG_INF = -1e30   # same masking constant as parallel/ring_attention.py
 _LANES = 128      # TPU lane width: m/l scratch replicate across lanes
 
@@ -165,7 +168,7 @@ def _fwd(q, k, v, causal, sm_scale, bq, bk, interpret):
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -289,7 +292,7 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, bq, bk, interpret):
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -319,7 +322,7 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, bq, bk, interpret):
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,

@@ -1,0 +1,11 @@
+"""Pallas API-drift shims shared by the TPU kernels.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+this image's 0.4.x jax only has the old spelling. One alias here keeps
+every kernel file on whichever the running jax provides (tier-1
+triage, ISSUE 5).
+"""
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, 'CompilerParams', None) or \
+    pltpu.TPUCompilerParams

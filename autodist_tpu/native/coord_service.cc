@@ -11,7 +11,8 @@
 // ahead only while min_step >= my_step - staleness), heartbeats for
 // fail-fast monitoring, and small metadata exchange (strategy ids).
 //
-// The binary tensor commands (BSET/BGET/BADD/BSTEP) are the PS data
+// The binary tensor commands (BSET/BGET/BADD/BSTEP, and the row-sparse
+// BSADD/BGETROWS) are the PS data
 // plane: the reference aggregates cross-worker gradients in
 // ConditionalAccumulators living on the PS task and rides TF's grpc
 // data plane for the bytes (ps_synchronizer.py:556-633); here workers
@@ -31,6 +32,21 @@
 // frames above AUTODIST_PS_CHUNK_BYTES): every update rule here is
 // elementwise, so ranged application is exact. A logical push counts
 // once, at its offset-0 chunk.
+//
+// Row-sparse tensor protocol (embedding variables): a push whose delta
+// touches few rows of a [rows, cols] table ships ONLY those rows.
+// BSADD's payload is `<nrows> little-endian int32 row indices ||
+// <nrows> rows of wire data` (row_bytes wire bytes per row; cols =
+// row_bytes / wire itemsize), applied as a scatter-add into the stored
+// tensor under its lock — addition commutes, so concurrent sparse and
+// dense pushes interleave exactly, and a delta whose untouched rows
+// are exactly zero loses nothing by dropping them. The optional
+// `<off> <total>` range counts ROWS of the logical push (the client
+// splits large row sets into chunks); fencing, the torn-read version
+// counter and chunk-sequence aborts behave exactly like BADD. BGETROWS
+// returns just the listed rows (its request payload is the int32
+// index vector), for refreshing a worker's proxy cache after a sparse
+// push without refetching the whole table.
 //
 // BSTEP keeps the optimizer step ON the PS (the reference re-creates
 // the user's optimizer over PS-resident variables so async workers
@@ -90,6 +106,16 @@
 //   BADD <key> <nbytes> <wire> [<off> <total>]  [payload] -> VAL <n>
 //       (atomic elementwise += ; creates the tensor if absent; returns
 //        the tensor's accumulated push count)
+//   BSADD <key> <nrows> <row_bytes> <wire> [<off> <total>]  [payload]
+//       -> VAL <n>   (row-sparse scatter-add: payload is <nrows> int32
+//        row indices then <nrows> rows of wire data; <off>/<total>
+//        count ROWS of the logical push; tensor must already exist)
+//   BGETROWS <key> <nrows> <ncols> <wire> [v]  [payload] -> VAL
+//       <nbytes> [<ver>]\n[payload]  | NONE   (fetch just the rows
+//        listed in the int32 request payload; "v" = version field,
+//        same torn-read semantics as BGET)
+//   BSTAT <key>                  -> VAL <pushes> <steps> <elems>
+//                                   <slot1> <slot2> | NONE
 //   BSTEP <key> <nbytes> <wire> <rule> <t> <p0> <p1> <p2> <p3>
 //         [<off> <total>]        [payload] -> VAL <t_used>
 //   PING                         -> PONG
@@ -579,6 +605,25 @@ size_t payload_size(const std::string& line) {
   std::istringstream in(line);
   std::string cmd, key;
   in >> cmd;
+  if (cmd == "BSADD") {
+    // <nrows> int32 indices + <nrows> rows of <row_bytes> wire bytes;
+    // guard the product against uint64 wraparound before comparing to
+    // the cap (a wrapped declaration must not buffer toward 2^64)
+    uint64_t nrows = 0, row_bytes = 0;
+    in >> key >> nrows >> row_bytes;
+    if (in.fail() || row_bytes > kMaxPayload ||
+        nrows > kMaxPayload / (4 + row_bytes))
+      return kBadPayload;
+    uint64_t total = nrows * (4 + row_bytes);
+    if (total > kMaxPayload) return kBadPayload;
+    return static_cast<size_t>(total);
+  }
+  if (cmd == "BGETROWS") {
+    uint64_t nrows = 0;
+    in >> key >> nrows;
+    if (in.fail() || nrows > kMaxPayload / 4) return kBadPayload;
+    return static_cast<size_t>(nrows * 4);
+  }
   if (cmd != "BSET" && cmd != "BADD" && cmd != "BSTEP") return 0;
   uint64_t nbytes = 0;
   in >> key >> nbytes;
@@ -843,6 +888,97 @@ std::string handle(const std::string& line, std::string_view payload,
       t->data[off + i] += delta[i];
     seq.finish(off + delta.size() >= total);
     return "VAL " + std::to_string(t->pushes);
+  }
+  if (cmd == "BSADD") {
+    // row-sparse scatter-add: the sparse sibling of BADD. Payload is
+    // <nrows> little-endian int32 row indices followed by <nrows> rows
+    // of wire data (row_bytes wire bytes each); every listed row is
+    // added into the stored [rows, cols] tensor at its index. The
+    // optional <off> <total> range counts ROWS of the logical push;
+    // fencing / sequence-abort semantics are exactly BADD's.
+    std::string k, wire;
+    uint64_t nrows = 0, row_bytes = 0;
+    in >> k >> nrows >> row_bytes >> wire;
+    const int64_t off_decl = declared_offset(&in);
+    if (is_fenced(*conn)) return abort_open_seq(conn, k, off_decl, kFencedErr);
+    const size_t itemsize = wire == "bf16" ? 2 : 4;
+    if (row_bytes == 0 || row_bytes % itemsize)
+      return abort_open_seq(conn, k, off_decl, "ERR bad row bytes");
+    const size_t ncols = static_cast<size_t>(row_bytes) / itemsize;
+    if (payload.size() < nrows * 4)
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
+    std::vector<int32_t> idx(nrows);
+    if (nrows) memcpy(idx.data(), payload.data(), nrows * 4);
+    std::vector<float> rows;
+    if (!decode_wire(payload.substr(nrows * 4), wire, &rows) ||
+        rows.size() != nrows * ncols)
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
+    size_t off, total;
+    if (!read_range(&in, static_cast<size_t>(nrows), &off, &total))
+      return abort_open_seq(conn, k, off_decl, "ERR bad range");
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
+    // unlike BADD, absence is an error: a row set cannot size the
+    // dense tensor it scatters into
+    if (!t) return abort_open_seq(conn, k, off_decl, "ERR no tensor");
+    std::lock_guard<std::mutex> l(t->mu);
+    if (reject_fenced_under_tensor_lock(conn, k, t.get(), off_decl))
+      return kFencedErr;
+    SeqFrame seq(t.get(), off, conn, k);
+    if (t->data.empty() || t->data.size() % ncols)
+      return seq.fail("ERR shape mismatch");
+    const size_t table_rows = t->data.size() / ncols;
+    for (uint64_t r = 0; r < nrows; ++r)
+      if (idx[r] < 0 || static_cast<size_t>(idx[r]) >= table_rows)
+        return seq.fail("ERR bad row index");
+    if (off == 0) ++t->pushes;  // one logical push counts once
+    for (uint64_t r = 0; r < nrows; ++r) {
+      float* dst = t->data.data() + static_cast<size_t>(idx[r]) * ncols;
+      const float* src = rows.data() + r * ncols;
+      for (size_t j = 0; j < ncols; ++j) dst[j] += src[j];
+    }
+    seq.finish(off + nrows >= total);
+    return "VAL " + std::to_string(t->pushes);
+  }
+  if (cmd == "BGETROWS") {
+    // fetch just the rows listed in the int32 request payload — the
+    // read half of the row-sparse plane (proxy-cache refresh after a
+    // sparse push, pull-ahead of a known next batch). The torn-read
+    // version contract matches BGET's "v" flag.
+    std::string k, wire;
+    uint64_t nrows = 0, ncols = 0;
+    in >> k >> nrows >> ncols >> wire;
+    if (wire.empty()) wire = "f32";
+    std::string flag;
+    bool want_ver = static_cast<bool>(in >> flag) && flag == "v";
+    // bound the reply like every other buffer (kMaxPayload of f32):
+    // an unvalidated nrows*ncols would let one request allocate
+    // hundreds of GB (or wrap size_t) and bad_alloc the service
+    constexpr uint64_t kMaxElems = kMaxPayload / sizeof(float);
+    if (ncols == 0 || ncols > kMaxElems || nrows > kMaxElems / ncols)
+      return "ERR reply too large";
+    if (payload.size() < nrows * 4) return "ERR bad payload";
+    std::vector<int32_t> idx(nrows);
+    if (nrows) memcpy(idx.data(), payload.data(), nrows * 4);
+    std::shared_ptr<Tensor> t = find_tensor(k, /*create=*/false);
+    if (!t) return "NONE";
+    std::lock_guard<std::mutex> l(t->mu);
+    if (t->data.size() % ncols) return "ERR shape mismatch";
+    const size_t table_rows = t->data.size() / ncols;
+    std::vector<float> rows(static_cast<size_t>(nrows) * ncols);
+    for (uint64_t r = 0; r < nrows; ++r) {
+      if (idx[r] < 0 || static_cast<size_t>(idx[r]) >= table_rows)
+        return "ERR bad row index";
+      memcpy(rows.data() + r * ncols,
+             t->data.data() + static_cast<size_t>(idx[r]) * ncols,
+             ncols * sizeof(float));
+    }
+    if (!encode_wire(rows.data(), rows.size(), wire, reply_payload))
+      return "ERR bad wire dtype";
+    std::string resp = "VAL " + std::to_string(reply_payload->size());
+    if (want_ver)
+      resp += " " + std::to_string(t->version * 2 +
+                                   (t->open_writes > 0 ? 1 : 0));
+    return resp;
   }
   if (cmd == "BSTEP") {
     std::string k, wire, rule;

@@ -231,6 +231,15 @@ def axis_size(axis_name):
         return int(jax.lax.psum(1, axis_name))
 
 
+def supports_partial_manual():
+    """True when this jax can lower partial-manual shard_map regions
+    (jax>=0.6 ``jax.shard_map`` with ``axis_names=``). Old jax's
+    partial-auto spelling crashes in lowering, so
+    :func:`shard_map_compat` refuses it up front — tests gate the
+    nested-manual kernel-dispatch paths on this probe."""
+    return hasattr(jax, 'shard_map')
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs, axis_names=None):
     """Partial-manual shard_map across jax spellings.
 

@@ -205,9 +205,22 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                         rows = dim * (j + 1) // k - dim * j // k
                         entries.append(entry('psum_scatter', plan,
                                              rows * row, [var.name]))
-            # the updated shard is re-gathered to full for the next step
-            entries.append(entry('all_gather', plan, padded,
-                                 [var.name], phase='param'))
+            # the updated shard is re-gathered for the next step. A
+            # sparse (embedding) table only needs its looked-up rows
+            # fresh — the loose-mode row-sparse plane refreshes them
+            # point-to-point (BGETROWS), and the SPMD lowering gathers
+            # rows, not the table — so the param phase is priced by
+            # expected touched rows, not O(vocab x dim): full-size
+            # pricing made AutoStrategy reject PS for exactly the
+            # variables PS exists for.
+            if sparse and plan.shard_axis == 0 and \
+                    sparse_bytes < padded:
+                entries.append(entry('sparse_all_gather', plan,
+                                     sparse_bytes, [var.name],
+                                     phase='param'))
+            else:
+                entries.append(entry('all_gather', plan, padded,
+                                     [var.name], phase='param'))
         elif sparse and type(plan.compressor) is comp.NoneCompressor \
                 and sparse_bytes < nbytes:
             entries.append(entry('sparse_all_gather', plan, sparse_bytes,

@@ -20,6 +20,13 @@ wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at rest on the service. This is
 the grpc-data-plane equivalent the reference rode for PS traffic; base64
 text framing (33% inflation, full-line buffering) is gone.
 
+Row-sparse forms (:meth:`CoordClient.vsadd` / ``vgetrows`` and their
+batched ``vmsadd`` / ``vmgetrows``) move only the TOUCHED rows of an
+embedding-style ``[rows, cols]`` tensor: a push ships ``int32 row
+indices || row data`` and the service scatter-adds it (BSADD), a fetch
+requests listed rows (BGETROWS) — O(batch) wire instead of
+O(vocab x dim) when a step touches few rows.
+
 The multi-tensor variants (:meth:`CoordClient.vmget` / ``vmset`` /
 ``vmadd``) PIPELINE their RPCs: all request frames are written ahead of
 draining the replies on the same socket, so a pull of N chunks pays one
@@ -355,13 +362,34 @@ class CoordClient:
     def _send_frame(self, line, payload=None):
         """Write one request frame (header line + optional raw payload)
         WITHOUT reading its reply — the building block the pipelined
-        multi-tensor calls (vmget/vmset/vmadd) write batches of."""
+        multi-tensor calls (vmget/vmset/vmadd/vmsadd) write batches of.
+
+        ``payload`` may be a LIST of buffers (scatter-gather framing:
+        the sparse plane's ``int32 indices || row data`` payloads ship
+        without a concat copy of the row bytes)."""
         hook = CoordClient.fault_hook
         if hook is not None:
+            if isinstance(payload, (list, tuple)):
+                # the hook contract is one flat buffer; hooks are
+                # test-only (faultline), so the join copy is fine there
+                payload = b''.join(bytes(b) for b in payload)
             replaced = hook(self, line, payload)
             if replaced is not None:
                 line, payload = replaced
         header = line.encode() + b'\n'
+        if isinstance(payload, (list, tuple)):
+            bufs = [b for b in payload if len(b)]
+            total = sum(len(b) for b in bufs)
+            if total <= 65536:
+                # small frame: one syscall/segment, like the scalar
+                # path below — the common O(batch)-rows sparse push
+                self._sock.sendall(
+                    header + b''.join(bytes(b) for b in bufs))
+            else:
+                self._sock.sendall(header)
+                for buf in bufs:
+                    self._sock.sendall(buf)
+            return
         if payload is not None and len(payload) > 65536:
             # large tensor frames: send header + payload separately to
             # avoid a whole-payload concat copy (TCP_NODELAY is set, and
@@ -744,6 +772,198 @@ class CoordClient:
         if errs:
             _raise_batch(errs)
         return pushes
+
+    # -- row-sparse tensor plane (embedding variables) ---------------------
+    @staticmethod
+    def _wire_itemsize(wire):
+        return 2 if wire == 'bf16' else 4
+
+    def _row_chunks(self, nrows, bytes_per_row):
+        """Row-chunk ranges [(off, count)] so no frame exceeds
+        ``AUTODIST_PS_CHUNK_BYTES`` of wire bytes (indices + row data
+        for pushes, row data for row fetches)."""
+        limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
+        if not limit or nrows * bytes_per_row <= limit:
+            return [(0, nrows)]
+        per = max(1, limit // bytes_per_row)
+        return [(off, min(per, nrows - off))
+                for off in range(0, nrows, per)]
+
+    def _sadd_frames(self, key, indices, rows, wire):
+        """The BSADD frame sequence for one row-sparse push (chunked
+        over ROWS like vset chunks over elements)."""
+        idx = np.asarray(indices, dtype=np.int32).reshape(-1)
+        if not idx.flags.c_contiguous:
+            idx = np.ascontiguousarray(idx)
+        rows = np.asarray(rows, dtype=np.float32)
+        if rows.ndim != 2 or rows.shape[0] != idx.size:
+            raise ValueError(
+                'vsadd(%s): rows must be [len(indices), cols]; got '
+                'indices %d, rows %r' % (key, idx.size, rows.shape))
+        row_wire = rows.shape[1] * self._wire_itemsize(wire)
+        ranges = self._row_chunks(idx.size, 4 + row_wire)
+        for off, count in ranges:
+            suffix = '' if len(ranges) == 1 else \
+                ' %d %d' % (off, idx.size)
+            # scatter-gather payload: int32 indices then the row data,
+            # no concat copy of the rows (the f32 path is a memoryview)
+            payload = [memoryview(idx[off:off + count]).cast('B'),
+                       _encode(rows[off:off + count], wire)]
+            yield (key, 'BSADD %s %d %d %s%s'
+                   % (key, count, row_wire, wire, suffix), payload)
+
+    def vsadd(self, key, indices, rows, wire=None):
+        """Row-sparse scatter-add: ``rows[r]`` is added into row
+        ``indices[r]`` of the stored ``[table_rows, cols]`` tensor.
+        Addition commutes, so sparse and dense pushes from concurrent
+        workers interleave exactly; a delta whose untouched rows are
+        exactly zero is applied LOSSLESSLY by shipping only its touched
+        rows. The tensor must already exist (a row set cannot size it).
+        Returns the tensor's total push count."""
+        return self.vmsadd([(key, indices, rows)], wire=wire)[key]
+
+    def vmsadd(self, items, wire=None):
+        """Pipelined multi-tensor :meth:`vsadd`: ``items`` is
+        ``[(key, indices, rows)]``; all request frames are written
+        ahead of draining replies, one wire round trip for the batch.
+        Returns ``{key: push count}``."""
+        wire = _wire_dtype(wire)
+        frames = [f for key, idx, rows in items
+                  for f in self._sadd_frames(key, idx, rows, wire)]
+        pushes = {}
+        errs = []
+
+        def reply(key):
+            resp = self._read_reply_line()
+            if not resp.startswith('VAL'):
+                errs.append('BSADD %s failed: %s' % (key, resp))
+                return
+            pushes[key] = int(resp[4:])
+
+        self._pipelined(frames, reply)
+        if errs:
+            _raise_batch(errs)
+        return pushes
+
+    def vgetrows(self, key, indices, ncols, wire=None):
+        """Fetch just the listed rows of a stored ``[rows, ncols]``
+        tensor as a float32 ``[len(indices), ncols]`` array, or None if
+        the tensor is absent. Single-key form of :meth:`vmgetrows`."""
+        return self.vmgetrows([(key, indices, ncols)], wire=wire)[0]
+
+    def vmgetrows(self, specs, dtype=np.float32, wire=None):
+        """Pipelined multi-tensor row fetch: ``specs`` is ``[(key,
+        indices, ncols)]``; returns one ``[len(indices), ncols]`` array
+        (or None if absent) per spec.
+
+        Torn-read contract (the BGET "v" semantics, scaled down to row
+        reads): every request opts into the version field; a key whose
+        parity comes back odd — or whose version moves between its own
+        row chunks — retries under the same AUTODIST_PS_TORN_RETRIES /
+        _BACKOFF_S budget as :meth:`vmget`, with the same stall window:
+        odd parity that stops advancing for ``stall_timeout_s`` is the
+        died-mid-push signature and raises. A version that keeps
+        MOVING but stays even means whole pushes keep landing — the
+        final assembly is returned (benign element-level staleness,
+        same caveat as vmget's)."""
+        wire = _wire_dtype(wire)
+        specs = [(key, np.ascontiguousarray(
+                     np.asarray(idx, dtype=np.int32).reshape(-1)),
+                  int(ncols)) for key, idx, ncols in specs]
+        row_wire = [ncols * self._wire_itemsize(wire)
+                    for _, _, ncols in specs]
+        results = [None] * len(specs)
+        max_attempts = max(1, ENV.AUTODIST_PS_TORN_RETRIES.val)
+        backoff = ENV.AUTODIST_PS_TORN_BACKOFF_S.val
+        stall_s = self.stall_timeout_s
+        last_ver = {}
+        last_progress = {}
+        pending = list(range(len(specs)))
+        for attempt in range(max_attempts):
+            final = attempt == max_attempts - 1
+            frames = []
+            for i in pending:
+                key, idx, ncols = specs[i]
+                for off, count in self._row_chunks(
+                        idx.size, max(1, row_wire[i])):
+                    frames.append(
+                        (i, 'BGETROWS %s %d %d %s v'
+                         % (key, count, ncols, wire),
+                         memoryview(idx[off:off + count]).cast('B')))
+            parts = {i: [] for i in pending}
+            first_ver = {}
+            cur_ver = {}
+            odd = set()
+            torn = set()
+            absent = set()
+            errors = []
+
+            def reply(i):
+                resp = self._read_reply_line()
+                if resp == 'NONE':
+                    absent.add(i)
+                    return
+                if not resp.startswith('VAL'):
+                    errors.append('BGETROWS %s failed: %s'
+                                  % (specs[i][0], resp))
+                    return
+                fields = resp.split()
+                parts[i].append(
+                    _decode(self._read_exact(int(fields[1])), wire))
+                ver = int(fields[2]) if len(fields) > 2 else None
+                if ver is None:
+                    return
+                cur_ver[i] = ver
+                if ver & 1:
+                    odd.add(i)
+                    torn.add(i)
+                elif i not in first_ver:
+                    first_ver[i] = ver
+                elif ver != first_ver[i]:
+                    torn.add(i)
+
+            self._pipelined(frames, reply)
+            if errors:
+                raise OSError('; '.join(errors))
+            now = time.monotonic()
+            retry = []
+            for i in pending:
+                key, idx, ncols = specs[i]
+                if i in absent:
+                    results[i] = None
+                    continue
+                if i not in torn or (final and i not in odd):
+                    if i in torn:
+                        logging.warning(
+                            'BGETROWS %s: version kept advancing for '
+                            '%d attempts (concurrent pushes); '
+                            'returning the last assembly', key,
+                            max_attempts)
+                    arr = np.concatenate(parts[i]) if len(parts[i]) > 1 \
+                        else parts[i][0]
+                    results[i] = arr.reshape(idx.size, ncols).astype(
+                        dtype, copy=False)
+                    continue
+                ver = cur_ver.get(i)
+                if ver != last_ver.get(i):
+                    last_ver[i] = ver
+                    last_progress[i] = now
+                elif i in odd and \
+                        now - last_progress.get(i, now) > stall_s:
+                    raise OSError(
+                        'BGETROWS %s: a chunked write is stuck '
+                        'mid-flight (version parity odd and not '
+                        'advancing for %.0fs) — a peer likely died '
+                        'mid-push' % (key, stall_s))
+                retry.append(i)
+            pending = retry
+            if not pending:
+                return results
+            time.sleep(min(max(0.2, backoff), backoff * (attempt + 1)))
+        raise OSError(
+            'BGETROWS %s: a chunked write was still mid-flight '
+            '(version parity odd) after %d attempts'
+            % (specs[pending[0]][0], max_attempts))
 
     def vstep(self, key, grad, rule, params, wire=None):
         """Push a raw GRADIENT; the service applies the named update

@@ -218,6 +218,21 @@ class Session:
                                for s in p.all_syncs)}
         self._shared_warned = set()
         self._shared_pushes = 0
+        # row-sparse PS data plane (BSADD/BGETROWS): sparse-flagged 2-D
+        # PS variables whose per-step delta touches few rows ship only
+        # those rows. Partitioned sparse vars qualify when partitioned
+        # on axis 0 (the axis the builders force for sparse vars).
+        self._sparse_vars = {
+            name for name, p in plan.var_plans.items()
+            if p.is_ps and getattr(p.var, 'sparse_read', False)
+            and len(p.var.shape) == 2
+            and (p.num_shards <= 1 or p.partition_axis == 0)}
+        self._sparse_stats = {
+            'sparse_pushes': 0, 'rows_pushed': 0,
+            'dense_bytes_avoided': 0, 'zero_push_skips': 0,
+            'row_refreshes': 0, 'rows_refreshed': 0,
+            'full_refreshes': 0}
+        self._sparse_refresh_count = {}
         # loose-mode PS data plane: a persistent TransferPool worker
         # (own connection) per endpoint, variables placed by
         # reduction_destination (multi-server PS)
@@ -733,7 +748,10 @@ class Session:
     def ps_stats(self):
         """Loose-mode wire accounting: payload bytes moved and seconds
         spent on PS pulls+pushes (the measured per-step PS overhead),
-        plus the per-endpoint byte split (balanced placement evidence)
+        plus the per-endpoint byte split (balanced placement evidence),
+        the row-sparse plane's counters (``sparse``: sparse_pushes,
+        rows_pushed, dense_bytes_avoided, zero_push_skips, row/full
+        refreshes — docs/design/sparse-ps.md)
         and the async-pipeline phase breakdown — per-train-step pull /
         step / push seconds, the wire seconds actually EXPOSED on the
         critical path, and ``overlap_frac`` = the fraction of wire time
@@ -744,7 +762,8 @@ class Session:
             out = {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
                    'bytes_per_endpoint': list(self._ps_ep_bytes),
                    'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
-                                if self._ps_seconds else 0.0)}
+                                if self._ps_seconds else 0.0),
+                   'sparse': dict(self._sparse_stats)}
         steps = max(1, ph['train_steps'])
         wire = ph['pull_s'] + ph['push_s']
         out['pipeline'] = {
@@ -1320,21 +1339,61 @@ class Session:
                              params['params']))
         return spec, extra
 
+    def _classify_push(self, deltas):
+        """Per-variable push mode for this step's deltas: the set of
+        all-zero deltas (skipped outright — frozen/eval-only variables
+        must not ship full zero tensors every push) and, for
+        sparse-flagged 2-D variables, the touched-row index vector when
+        the touched fraction is at or below
+        ``AUTODIST_SPARSE_PUSH_MAX_FRAC``. Lossless by construction:
+        a dropped row's delta is exactly zero, so the BSADD scatter-add
+        lands bit-identically to the dense BADD."""
+        frac = ENV.AUTODIST_SPARSE_PUSH_MAX_FRAC.val
+        zero_skip = set()
+        sparse_rows = {}
+        for name, delta in deltas.items():
+            if frac and name in self._sparse_vars:
+                # one scan: the row mask also answers "all zero"
+                touched = np.flatnonzero(
+                    np.any(delta != 0, axis=1)).astype(np.int32)
+                if touched.size == 0:
+                    zero_skip.add(name)
+                elif touched.size <= frac * delta.shape[0]:
+                    sparse_rows[name] = touched
+                continue
+            if not delta.any():
+                zero_skip.add(name)
+        return zero_skip, sparse_rows
+
+    def _shard_row_starts(self, name, pc):
+        """Cumulative row offsets of an axis-0-partitioned variable's
+        shards (sparse vars are forced to axis 0 by the builders)."""
+        var = self._graph_item.var_by_name(name)
+        rows = [int(s[0]) for s in pc.shard_shapes(var.shape)]
+        starts = [0]
+        for r in rows:
+            starts.append(starts[-1] + r)
+        return starts
+
     def _push_ps_deltas(self, pulled, shared_push=None):
         """Push per-variable updates. Default: ``new - pulled`` deltas —
         the binary BADD is commutative, so concurrent workers' updates
         accumulate exactly like the reference's apply-per-push
-        accumulators. Vars in ``shared_push`` instead ship their raw
+        accumulators. Sparse-flagged variables whose delta touches few
+        rows ship ONLY those rows (``vmsadd``/BSADD — O(batch) wire
+        instead of O(vocab x dim)); all-zero deltas are skipped
+        entirely. Vars in ``shared_push`` instead ship their raw
         gradient; the service applies the optimizer step with
         PS-resident shared slots (BSTEP). Partitioned variables push
         each shard's slice to that shard's own endpoint (the reference
         splits gradients per shard, kernel/partitioner.py:686-704).
         Endpoint groups push in parallel on the TransferPool workers,
-        each as ONE pipelined ``vmadd`` batch (plus serial ``vstep``
-        for shared-optimizer vars — the chunk-shared step index makes
-        those inherently sequential). At pipeline depth >= 2 this whole
-        method runs on the background pipeline thread, including the
-        device->host readback of the updated state."""
+        each as ONE pipelined ``vmadd`` + one ``vmsadd`` batch (plus
+        serial ``vstep`` for shared-optimizer vars — the chunk-shared
+        step index makes those inherently sequential). At pipeline
+        depth >= 2 this whole method runs on the background pipeline
+        thread, including the device->host readback of the updated
+        state."""
         import time as _time
         t0 = _time.perf_counter()
         shared_push = shared_push or {}
@@ -1344,39 +1403,140 @@ class Session:
         deltas = {name: after - np.asarray(pulled[name],
                                            dtype=np.float32)
                   for name, after in afters.items()}
+        zero_skip, sparse_rows = self._classify_push(deltas)
         groups, _ = self._transfer_groups(list(pulled))
 
-        def push_group(units):
-            def go(client):
-                adds = []
-                for key, name, i, pc in units:
-                    if name in shared_push:
-                        g, rule, params = shared_push[name]
-                        if pc is not None:
-                            g = pc.split(g)[i]
-                        client.vstep(self._key(key), g, rule, params)
+        # plan every endpoint's batch on THIS thread (the pool workers
+        # only move bytes), accounting the exact wire cost as we go
+        ep_jobs = {}
+        ep_bytes = [0] * len(self._ps_addrs)
+        wire_bytes = 0
+        rows_pushed = 0
+        bytes_avoided = 0
+        for ep, units in groups.items():
+            job = ep_jobs.setdefault(
+                ep, {'steps': [], 'adds': [], 'sadds': []})
+            for key, name, i, pc in units:
+                if name in shared_push:
+                    g, rule, params = shared_push[name]
+                    if pc is not None:
+                        g = pc.split(g)[i]
+                    job['steps'].append(
+                        (self._key(key), g, rule, params))
+                    nb = self._wire_nbytes(g.size)
+                elif name in zero_skip:
+                    full = deltas[name] if pc is None else \
+                        pc.split(deltas[name])[i]
+                    bytes_avoided += self._wire_nbytes(full.size)
+                    continue
+                elif name in sparse_rows:
+                    delta = deltas[name]
+                    idx = sparse_rows[name]
+                    if pc is None:
+                        local, rows = idx, delta[idx]
                     else:
-                        delta = deltas[name]
-                        if pc is not None:
-                            delta = pc.split(delta)[i]
-                        adds.append((self._key(key), delta))
-                if adds:
-                    client.vmadd(adds)
+                        starts = self._shard_row_starts(name, pc)
+                        lo, hi = starts[i], starts[i + 1]
+                        sel = idx[(idx >= lo) & (idx < hi)]
+                        dense_nb = self._wire_nbytes(
+                            (hi - lo) * delta.shape[1])
+                        if sel.size == 0:
+                            bytes_avoided += dense_nb
+                            continue
+                        local = (sel - lo).astype(np.int32)
+                        rows = delta[sel]
+                    job['sadds'].append((self._key(key), local, rows))
+                    nb = local.size * 4 + \
+                        self._wire_nbytes(rows.size)
+                    dense_elems = (delta.shape[0] if pc is None
+                                   else hi - lo) * delta.shape[1]
+                    bytes_avoided += self._wire_nbytes(dense_elems) - nb
+                    rows_pushed += local.size
+                else:
+                    delta = deltas[name]
+                    if pc is not None:
+                        delta = pc.split(delta)[i]
+                    job['adds'].append((self._key(key), delta))
+                    nb = self._wire_nbytes(delta.size)
+                wire_bytes += nb
+                ep_bytes[ep] += nb
+
+        def push_group(job):
+            def go(client):
+                for key, g, rule, params in job['steps']:
+                    client.vstep(key, g, rule, params)
+                if job['adds']:
+                    client.vmadd(job['adds'])
+                if job['sadds']:
+                    client.vmsadd(job['sadds'])
             return go
 
-        self._pool.run([(ep, push_group(units))
-                        for ep, units in groups.items()])
-        with self._stats_lock:
-            for name in pulled:
-                self._account_ep_bytes(name)
+        self._pool.run([(ep, push_group(job))
+                        for ep, job in ep_jobs.items()])
         self._shared_pushes += sum(1 for n in pulled if n in shared_push)
-        n_elems = sum(a.size for a in afters.values()) + \
-            sum(g.size for g, _, _ in shared_push.values())
 
         # post-update assign (proxy_variable.py:163-190): refresh the
-        # proxy from the PS after the push, off the pre-step path
-        if self._proxy_vars:
-            refreshed, _ = self._fetch_var_parts(list(self._proxy_vars))
+        # proxy from the PS after the push, off the pre-step path. A
+        # sparse push refreshes only ITS rows (vmgetrows) — rows other
+        # workers touched converge via the periodic full refresh
+        # (AUTODIST_SPARSE_FULL_REFRESH_EVERY); a zero push leaves the
+        # cache as is on the same schedule.
+        refresh_bytes, refresh_ep = self._refresh_proxies(
+            zero_skip, sparse_rows)
+        wire_bytes += refresh_bytes
+        for ep, nb in refresh_ep.items():
+            ep_bytes[ep] += nb
+        push_s = _time.perf_counter() - t0
+        with self._stats_lock:
+            if not self._ps_ep_bytes:
+                self._ps_ep_bytes = [0] * len(self._ps_addrs)
+            for ep, nb in enumerate(ep_bytes):
+                self._ps_ep_bytes[ep] += nb
+            self._ps_seconds += push_s
+            self._ps_bytes += wire_bytes
+            self._ps_phase['push_s'] += push_s
+            ss = self._sparse_stats
+            ss['sparse_pushes'] += len(sparse_rows)
+            ss['rows_pushed'] += rows_pushed
+            ss['zero_push_skips'] += len(zero_skip)
+            ss['dense_bytes_avoided'] += bytes_avoided
+        return push_s
+
+    def _refresh_proxies(self, zero_skip, sparse_rows):
+        """Post-push proxy-cache refresh. Unpartitioned sparse-pushed
+        vars with a warm cache refresh only their pushed rows
+        (BGETROWS); every ``AUTODIST_SPARSE_FULL_REFRESH_EVERY``-th
+        refresh falls back to a full fetch so other workers' rows
+        converge; everything else takes the legacy full fetch. Returns
+        (wire bytes moved, {endpoint: bytes})."""
+        if not self._proxy_vars:
+            return 0, {}
+        refresh_every = ENV.AUTODIST_SPARSE_FULL_REFRESH_EVERY.val
+        full_names = []
+        row_specs = {}   # name -> touched row indices
+        for name in self._proxy_vars:
+            pc, _ = self._shard_info(name)
+            sparse_capable = (pc is None and name in self._proxy_cache
+                              and name in self._sparse_vars)
+            rowset = sparse_rows.get(name)
+            if rowset is None and sparse_capable and name in zero_skip:
+                rowset = np.empty(0, np.int32)
+            if rowset is None or not sparse_capable:
+                full_names.append(name)
+                continue
+            cnt = self._sparse_refresh_count.get(name, 0) + 1
+            if refresh_every and cnt >= refresh_every:
+                self._sparse_refresh_count[name] = 0
+                full_names.append(name)
+            else:
+                self._sparse_refresh_count[name] = cnt
+                if rowset.size:
+                    row_specs[name] = rowset
+        wire = 0
+        ep_bytes = {}
+        full_refreshes = 0
+        if full_names:
+            refreshed, _ = self._fetch_var_parts(full_names)
             for name, parts in refreshed.items():
                 pc, _ = self._shard_info(name)
                 served = parts[0] if pc is None else (
@@ -1386,13 +1546,55 @@ class Session:
                     var = self._graph_item.var_by_name(name)
                     self._proxy_cache[name] = \
                         served.astype(var.init_value.dtype)
-                    n_elems += served.size
-        push_s = _time.perf_counter() - t0
+                    wire += self._wire_nbytes(served.size)
+                    # the counter tracks the SPARSE plane's full-refresh
+                    # fallback; dense proxy vars full-refresh every
+                    # step by design and would drown the signal
+                    if name in self._sparse_vars:
+                        full_refreshes += 1
+                    idxs = self._shard_endpoints(name, len(parts))
+                    sizes = [served.size] if pc is None else \
+                        [p.size for p in parts]
+                    for ep_i, sz in zip(idxs, sizes):
+                        ep_bytes[ep_i] = ep_bytes.get(ep_i, 0) + \
+                            self._wire_nbytes(sz)
+        if row_specs:
+            by_ep = {}
+            for name, idx in row_specs.items():
+                _, keys = self._shard_info(name)
+                ep = self._shard_endpoints(name, 1)[0]
+                ncols = int(
+                    self._graph_item.var_by_name(name).shape[1])
+                by_ep.setdefault(ep, []).append(
+                    (name, self._key(keys[0]), idx, ncols))
+
+            def fetch_rows(specs):
+                def go(client):
+                    arrs = client.vmgetrows(
+                        [(key, idx, ncols)
+                         for _, key, idx, ncols in specs])
+                    return [(name, idx, a) for (name, _, idx, _), a
+                            in zip(specs, arrs)]
+                return go
+
+            for got in self._pool.run(
+                    [(ep, fetch_rows(specs))
+                     for ep, specs in by_ep.items()]):
+                for name, idx, arr in got:
+                    if arr is None:   # pragma: no cover - init race
+                        continue
+                    cache = self._proxy_cache[name]
+                    cache[idx] = arr.astype(cache.dtype)
+                    nb = idx.size * 4 + self._wire_nbytes(arr.size)
+                    wire += nb
+                    ep = self._shard_endpoints(name, 1)[0]
+                    ep_bytes[ep] = ep_bytes.get(ep, 0) + nb
         with self._stats_lock:
-            self._ps_seconds += push_s
-            self._ps_bytes += self._wire_nbytes(n_elems)
-            self._ps_phase['push_s'] += push_s
-        return push_s
+            self._sparse_stats['row_refreshes'] += len(row_specs)
+            self._sparse_stats['rows_refreshed'] += \
+                sum(i.size for i in row_specs.values())
+            self._sparse_stats['full_refreshes'] += full_refreshes
+        return wire, ep_bytes
 
     def _contract(self, fetch, stacked, split_sizes):
         """Apply the reference fetch contract to the per-replica stack."""

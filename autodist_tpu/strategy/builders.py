@@ -296,17 +296,22 @@ class AutoStrategy(StrategyBuilder):
             when the trace has no collectives (CPU fallback).
         num_replicas: override the replica count the simulator prices
             (default: the spec's accelerator count).
+        sparse_lookups_per_replica: expected embedding rows one replica
+            looks up per step — batch-derived (pass the per-replica
+            batch size, or batch x ids-per-example); prices sparse
+            variables' PS traffic by touched rows instead of full size.
     """
 
     def __init__(self, memory_budget_bytes=None, optimizer_slots=2,
                  candidates=None, cost_params=None, trace_dir=None,
-                 num_replicas=None):
+                 num_replicas=None, sparse_lookups_per_replica=4096):
         self._budget = memory_budget_bytes
         self._optimizer_slots = optimizer_slots
         self._candidates = candidates
         self._cost_params = cost_params
         self._trace_dir = trace_dir
         self._num_replicas = num_replicas
+        self._sparse_lookups = sparse_lookups_per_replica
         # populated by build() for audits / bench reporting
         self.last_ranked = []
         self.last_infeasible = []
@@ -328,7 +333,8 @@ class AutoStrategy(StrategyBuilder):
         feasible, infeasible = search.rank(
             graph_item, resource_spec, candidates=self._candidates,
             memory_budget_bytes=self._budget, params=params,
-            num_replicas=n, optimizer_slots=self._optimizer_slots)
+            num_replicas=n, optimizer_slots=self._optimizer_slots,
+            sparse_lookups_per_replica=self._sparse_lookups)
         self.last_ranked = feasible
         self.last_infeasible = infeasible
         if not feasible:

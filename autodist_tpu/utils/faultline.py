@@ -164,9 +164,13 @@ def _parse_publish(line):
 
 def _continuation_offset(line):
     """The declared offset of a ranged B* frame (``... <off> <total>``),
-    or None for whole-tensor frames."""
+    or None for whole-tensor frames. BSADD ranges count rows; the
+    offset semantics (0 = opening chunk) are identical."""
     parts = line.split()
-    if len(parts) < 6 or parts[0] not in ('BSET', 'BADD'):
+    if parts and parts[0] == 'BSADD':
+        if len(parts) < 7:
+            return None
+    elif len(parts) < 6 or parts[0] not in ('BSET', 'BADD'):
         return None
     try:
         return int(parts[-2])
@@ -299,11 +303,17 @@ class FaultLine:
         return replacement
 
     def _tear(self, client, line, payload):
-        """Rewrite a whole-tensor BSET/BADD as the opening chunk of a
-        write twice its size, then kill the connection: the canonical
-        died-mid-chunked-push wreckage (version parity stays odd until
-        the reader's stall timeout declares the writer dead)."""
+        """Rewrite a whole-tensor BSET/BADD (or whole-push BSADD) as
+        the opening chunk of a write twice its size, then kill the
+        connection: the canonical died-mid-chunked-push wreckage
+        (version parity stays odd until the reader's stall timeout
+        declares the writer dead). A BSADD's range counts ROWS, so the
+        phantom continuation is another <nrows> rows."""
         parts = line.split()
+        if parts and parts[0] == 'BSADD' and len(parts) == 5:
+            nrows = int(parts[2])
+            self._dead.add(id(client))
+            return ('%s 0 %d' % (line, 2 * nrows), payload)
         if len(parts) != 4 or parts[0] not in ('BSET', 'BADD'):
             logging.warning('faultline: torn_frame matched a non-whole-'
                             'tensor frame %r; leaving it intact',

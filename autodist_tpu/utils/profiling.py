@@ -231,6 +231,41 @@ def ps_overlap_report(ps_stats):
     }
 
 
+def ps_sparse_report(ps_stats):
+    """The row-sparse PS plane's counters plus derived ratios.
+
+    ``ps_stats`` is :attr:`Session.ps_stats`; its ``sparse`` block
+    counts sparse pushes, rows pushed, dense bytes avoided, zero-push
+    skips and row/full proxy refreshes (docs/design/sparse-ps.md).
+    Adds ``avoided_frac`` — the fraction of would-have-been wire bytes
+    the sparse plane (and the zero-delta skip) saved: avoided /
+    (avoided + bytes actually moved). Returns ``{}`` when the session
+    kept no sparse counters (non-loose, or pre-sparse-plane stats)."""
+    sparse = dict((ps_stats or {}).get('sparse') or {})
+    if not sparse:
+        return {}
+    moved = (ps_stats or {}).get('bytes', 0)
+    avoided = sparse.get('dense_bytes_avoided', 0)
+    sparse['avoided_frac'] = (
+        avoided / float(avoided + moved) if avoided + moved else 0.0)
+    return sparse
+
+
+def format_ps_sparse(report):
+    """Human-readable rendering of :func:`ps_sparse_report`."""
+    if not report:
+        return '(no sparse-plane counters)'
+    return ('sparse pushes %d (%d rows)  zero-skips %d  refreshes '
+            '%d row / %d full  avoided %.1f MB (%.0f%% of would-be '
+            'wire)' % (report.get('sparse_pushes', 0),
+                       report.get('rows_pushed', 0),
+                       report.get('zero_push_skips', 0),
+                       report.get('row_refreshes', 0),
+                       report.get('full_refreshes', 0),
+                       report.get('dense_bytes_avoided', 0) / 1e6,
+                       100.0 * report.get('avoided_frac', 0.0)))
+
+
 def health_report(health_stats, faultline=None):
     """Recovery observability: one record per run of everything the
     elastic-recovery machinery did — so every recovery is auditable,

@@ -352,6 +352,38 @@ def bench_longctx(steps):
     return batch_size * seq * steps / dt, stats
 
 
+def ensure_platform(probe_timeout_s=120.0):
+    """Decide the platform BEFORE any in-process device query.
+
+    BENCH_r05 regression: with an unavailable/busy TPU plugin the first
+    in-process ``jax.devices()`` can raise UNAVAILABLE — or hang on
+    driver acquisition — and a failed backend init is not reliably
+    recoverable in-process, so the record came back rc=1 with no data.
+    Probe device availability in a SUBPROCESS with a timeout; if the
+    probe fails or times out, set ``JAX_PLATFORMS=cpu`` (8 virtual
+    devices) in this process's environment before jax's backend ever
+    initializes. An explicit ``JAX_PLATFORMS`` is respected as is.
+    Returns True when the CPU fallback engaged.
+    """
+    import subprocess
+    import sys
+    if os.environ.get('JAX_PLATFORMS'):
+        return False
+    try:
+        ok = subprocess.run(
+            [sys.executable, '-c', 'import jax; jax.devices()'],
+            timeout=probe_timeout_s, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        ok = False
+    if ok:
+        return False
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    from autodist_tpu.utils.jax_env import force_cpu_host_devices
+    force_cpu_host_devices(8)
+    return True
+
+
 def resolve_devices():
     """``jax.devices()`` with a CPU fallback for TPU-less hosts.
 
@@ -679,6 +711,133 @@ def _bench_ps_pipeline_inner(steps):
     }
 
 
+def bench_sparse_ps(steps=10):
+    """Row-sparse PS data-plane A/B (ISSUE 5 acceptance).
+
+    Runs the SAME single-process loose-mode NCF-style embedding
+    workload (a [vocab, dim] table under ``embedding_lookup`` + a dense
+    head, PS strategy with a local proxy, LazyAdam so deltas stay
+    row-sparse) twice: with the sparse plane disabled
+    (``AUTODIST_SPARSE_PUSH_MAX_FRAC=0`` — every push/refresh moves the
+    whole table) and at the default threshold (touched rows ride
+    BSADD/BGETROWS). Records bytes-on-wire, per-step wall, the sparse
+    counters, and the max abs difference of the final PS-resident table
+    across planes — dropping exactly-zero rows is lossless, so the
+    expected diff is 0.0.
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_sparse_ps_inner(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _sparse_ps_run(port, steps, max_frac, ids_per_step, vocab, dim):
+    """One fresh loose-mode run at the given sparse-push threshold.
+    Returns (per-step wall s, ps_stats BEFORE the final authoritative
+    read — the A/B must compare steady-state wire traffic, not the
+    teardown fetch — and the final table)."""
+    import time
+
+    import autodist_tpu as ad
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+
+    saved = os.environ.get('AUTODIST_SPARSE_PUSH_MAX_FRAC')
+    os.environ['AUTODIST_SPARSE_PUSH_MAX_FRAC'] = str(max_frac)
+    try:
+        with single_process_loose_env(port, depth=1) as sees_one:
+            autodist = ad.AutoDist(
+                resource_info={'nodes': [
+                    {'address': 'localhost', 'gpus': [0], 'chief': True,
+                     'network_bandwidth': 100}]},
+                strategy_builder=ad.strategy.PS(
+                    staleness=2, local_proxy_variable=True))
+            rng = np.random.RandomState(0)
+            E0 = (rng.randn(vocab, dim) * 0.05).astype(np.float32)
+            W0 = (rng.randn(dim, 1) * 0.05).astype(np.float32)
+            with autodist.scope():
+                x = ad.placeholder(shape=[None], dtype=np.int32,
+                                   name='ids')
+                E = ad.Variable(E0, name='E')
+                W = ad.Variable(W0, name='W')
+                emb = ad.ops.embedding_lookup(E, x)
+                logits = ad.ops.matmul(emb, W)
+                loss = ad.ops.reduce_mean(ad.ops.square(logits))
+                train_op = ad.optimizers.LazyAdam(1e-3).minimize(
+                    loss, [E, W])
+                autodist._build()
+                sees_one()
+                sess = autodist.create_distributed_session()
+                sess.run(train_op, {x: ids_per_step[0]})  # compile+warm
+                t0 = time.perf_counter()
+                for ids in ids_per_step[1:]:
+                    sess.run(train_op, {x: ids})
+                dt = (time.perf_counter() - t0) / max(
+                    1, len(ids_per_step) - 1)
+                stats = sess.ps_stats
+                e_final = sess.get_variable_value('E')
+                sess.close()
+            return dt, stats, e_final
+    finally:
+        if saved is None:
+            os.environ.pop('AUTODIST_SPARSE_PUSH_MAX_FRAC', None)
+        else:
+            os.environ['AUTODIST_SPARSE_PUSH_MAX_FRAC'] = saved
+
+
+def _bench_sparse_ps_inner(steps):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    vocab, dim, batch = 16384, 64, 256
+    rng = np.random.RandomState(7)
+    # the SAME id sequence drives both planes (exactness requires
+    # identical math; repeated ids per batch exercise scatter-add)
+    ids_per_step = [rng.randint(0, vocab, (batch,), dtype=np.int32)
+                    for _ in range(steps + 1)]
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    try:
+        d_dt, d_stats, d_final = _sparse_ps_run(
+            port, steps, 0.0, ids_per_step, vocab, dim)
+        s_dt, s_stats, s_final = _sparse_ps_run(
+            port, steps, '', ids_per_step, vocab, dim)   # '' = default
+    finally:
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    def block(dt, stats):
+        return {'per_step_wall_s': round(dt, 5),
+                'bytes_on_wire': stats['bytes'],
+                'per_step_bytes': stats['bytes'] // max(1, steps),
+                'sparse_counters': stats.get('sparse', {})}
+
+    from autodist_tpu.const import ENV
+    return {
+        'steps_per_plane': steps,
+        'vocab': vocab, 'dim': dim, 'ids_per_step': batch,
+        'threshold': ENV.AUTODIST_SPARSE_PUSH_MAX_FRAC.val,
+        'dense': block(d_dt, d_stats),
+        'sparse': block(s_dt, s_stats),
+        'bytes_reduction': round(
+            d_stats['bytes'] / s_stats['bytes'], 2)
+        if s_stats['bytes'] else 0.0,
+        'state_max_abs_diff': float(np.abs(d_final - s_final).max()),
+    }
+
+
 def bench_recovery(steps=6, kill_at=2):
     """Elastic-recovery A/B (ISSUE 4 acceptance).
 
@@ -951,11 +1110,16 @@ def bench_scaling(steps=5):
 def main():
     import sys
 
+    # platform decision FIRST — before any import-time or in-process
+    # device query can hang or poison the backend (BENCH_r05)
+    fell_back = ensure_platform()
+
     import jax
 
     from autodist_tpu.utils.jax_env import apply_jax_env_overrides
     apply_jax_env_overrides()
-    devices, fell_back = resolve_devices()
+    devices, fb = resolve_devices()
+    fell_back = fell_back or fb
     if '--scaling' in sys.argv:
         result = bench_scaling()
         result['extra']['cpu_fallback'] = fell_back
@@ -964,6 +1128,7 @@ def main():
         result['extra']['simulator'] = bench_simulator()
         result['extra']['ps_pipeline'] = bench_ps_pipeline()
         result['extra']['recovery'] = bench_recovery()
+        result['extra']['sparse_ps'] = bench_sparse_ps()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -979,6 +1144,7 @@ def main():
     simulator = bench_simulator()
     ps_pipeline = bench_ps_pipeline()
     recovery = bench_recovery()
+    sparse_ps = bench_sparse_ps()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -996,6 +1162,7 @@ def main():
                 'simulator': simulator,
                 'ps_pipeline': ps_pipeline,
                 'recovery': recovery,
+                'sparse_ps': sparse_ps,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -1048,7 +1215,8 @@ def main():
                       'grad_sync': grad_sync,
                       'simulator': simulator,
                       'ps_pipeline': ps_pipeline,
-                      'recovery': recovery},
+                      'recovery': recovery,
+                      'sparse_ps': sparse_ps},
         }
     print(json.dumps(result))
 

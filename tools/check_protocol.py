@@ -1,0 +1,73 @@
+"""Coord-service protocol drift check.
+
+Asserts that the command list documented in ``coord_service.cc``'s
+header comment matches the dispatcher's actual ``cmd == "..."`` set.
+The two have drifted before (BSTAT shipped undocumented), and the
+header is what operators and the client read — a drifted header is a
+protocol doc bug.
+
+Run:  python tools/check_protocol.py      (exit 0 = in sync)
+Wired into tier-1 via tests/test_sparse_ps.py.
+"""
+import os
+import re
+import sys
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   'autodist_tpu', 'native', 'coord_service.cc')
+
+#: AUTH is consumed by the connection handshake (serve_conn) before any
+#: command reaches handle(); it belongs in the header but can never
+#: appear in the dispatcher.
+HANDSHAKE_ONLY = {'AUTH'}
+
+
+def documented_commands(text):
+    """Commands listed in the header comment's protocol table: lines of
+    the form ``//   CMD <args...> -> reply`` before the first
+    ``#include`` (continuation lines are indented further and reply
+    tokens never start a line)."""
+    header = text.split('#include', 1)[0]
+    return set(re.findall(r'^//   ([A-Z][A-Z0-9]*)\b', header, re.M))
+
+
+def dispatched_commands(text):
+    """Commands the dispatcher actually matches (``cmd == "..."``)."""
+    return set(re.findall(r'cmd == "([A-Z][A-Z0-9]*)"', text))
+
+
+def find_drift(text=None):
+    """Returns a list of human-readable drift problems (empty = in
+    sync)."""
+    if text is None:
+        with open(SRC) as f:
+            text = f.read()
+    doc = documented_commands(text)
+    disp = dispatched_commands(text)
+    problems = []
+    for cmd in sorted(disp - doc):
+        problems.append('dispatched but not documented in the header '
+                        'comment: %s' % cmd)
+    for cmd in sorted(doc - disp - HANDSHAKE_ONLY):
+        problems.append('documented in the header comment but not '
+                        'dispatched: %s' % cmd)
+    if not doc:
+        problems.append('no documented commands found — the header '
+                        'comment table moved or changed format')
+    return problems
+
+
+def main(argv=None):
+    problems = find_drift()
+    if problems:
+        print('coord_service.cc protocol drift:')
+        for p in problems:
+            print('  - ' + p)
+        return 1
+    print('coord_service.cc header comment and dispatcher agree (%d '
+          'commands)' % len(dispatched_commands(open(SRC).read())))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

@@ -101,6 +101,11 @@ def main(argv=None):
                         '(AUTODIST_PS_PIPELINE_DEPTH>=2) hides; take it '
                         'from a measured ps_stats overlap_frac. 0 '
                         '(default) prices the serial depth-1 plane')
+    p.add_argument('--sparse-lookups', type=int, default=4096,
+                   help='expected embedding rows one replica looks up '
+                        'per step (batch-derived); sparse variables\' '
+                        'PS traffic is priced by touched rows, not '
+                        'full table size')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -130,7 +135,8 @@ def main(argv=None):
     budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
     feasible, infeasible = search.rank(
         gi, rs, memory_budget_bytes=budget, params=params,
-        num_replicas=n, optimizer_slots=slots)
+        num_replicas=n, optimizer_slots=slots,
+        sparse_lookups_per_replica=args.sparse_lookups)
     if args.json:
         print(json.dumps({
             'model': args.model,
