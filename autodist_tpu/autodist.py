@@ -246,6 +246,15 @@ class AutoDist:
                 # cohort already passed (the strategy keys are stable
                 # from here on)
                 self._coord.set('ctrl/init-done/%s' % ns, '1')
+            elif ENV.AUTODIST_ELASTIC_JOIN.val:
+                # a live JOINer (elastic scale-up) starts, by
+                # definition, after the cohort's init rendezvous: it is
+                # not a party the chief counted, so joining the barrier
+                # would poison its arrival count — wait for the marker
+                # directly (the Session-level admit handshake then
+                # waits for session/init-done the same way)
+                self._coord.wait_key('ctrl/init-done/%s' % ns,
+                                     timeout_s=120.0)
             else:
                 # A worker cannot locally distinguish "fresh cohort
                 # member" from "supervised replacement whose cohort

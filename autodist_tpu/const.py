@@ -87,6 +87,22 @@ def _frac(name, raw, default):
     return val
 
 
+def _max_workers(name, raw):
+    """Validated env parse for the elastic scale-up ceiling: an integer
+    >= the live ``AUTODIST_MIN_WORKERS`` floor (the two bounds must
+    describe a non-empty membership band). The default stays above any
+    explicitly raised floor."""
+    lo = ENV.AUTODIST_MIN_WORKERS.val
+    if not raw:
+        return max(64, lo)
+    val = int(raw)
+    if val < lo:
+        raise ValueError(
+            '%s must be >= AUTODIST_MIN_WORKERS (%d); got %r'
+            % (name, lo, raw))
+    return val
+
+
 def _choice(name, raw, default, allowed):
     """Validated env parse: one of a closed set of strings."""
     if not raw:
@@ -215,6 +231,20 @@ class ENV(Enum):
     # many live workers fails instead of shrinking further.
     AUTODIST_MIN_WORKERS = \
         (lambda v: _min_int('AUTODIST_MIN_WORKERS', v, 1, lo=1),)
+    # ceiling for elastic scale-UP: a live JOIN (or an autoscale
+    # decision) that would grow the membership past this many workers
+    # is refused. Validated >= AUTODIST_MIN_WORKERS at parse time; the
+    # launch quorum itself is not bounded by it (it caps joins only).
+    AUTODIST_MAX_WORKERS = \
+        (lambda v: _max_workers('AUTODIST_MAX_WORKERS', v),)
+    # marks a process as a live JOINer into an already-running loose-
+    # mode namespace: the session skips the launch-cohort rendezvous,
+    # claims a fresh worker slot at the control plane (the admit
+    # handshake — runtime/session.py admit_worker), pulls current
+    # params from the PS and adopts the published step floor. Set by
+    # Coordinator.scale_up on the processes it launches; never set on
+    # the launch cohort.
+    AUTODIST_ELASTIC_JOIN = (lambda v: (v == 'True' or v == '1'),)
     # policy=restart: how many supervised restarts one worker gets
     # (capped exponential backoff between attempts) before the
     # coordinator marks it permanently failed and aborts the run.

@@ -44,6 +44,10 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_MIN_WORKERS,
                     ENV.AUTODIST_MAX_WORKER_RESTARTS,
                     ENV.AUTODIST_RESTART_WAIT_S,
+                    # elastic scale-up: every worker judges the join
+                    # ceiling identically (a joiner enforces it at its
+                    # own admit claim)
+                    ENV.AUTODIST_MAX_WORKERS,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 
@@ -195,6 +199,153 @@ class WorkerSupervisor:
         with self._spawn_lock:
             if self.proc is not None and self.proc.poll() is None:
                 self.proc.terminate()
+
+
+def autoscale_policy(step_time_target_s=None, queue_depth_max=None,
+                     grow_by=1):
+    """The built-in autoscale policy: grow when the observed per-step
+    wall time exceeds ``step_time_target_s`` or the input queue depth
+    exceeds ``queue_depth_max`` (either signal suffices; unset signals
+    are ignored). Returns a policy callable
+    ``policy(metrics, current_world) -> desired world | None`` for
+    :class:`AutoscaleController` — ``None`` means "no opinion, keep
+    the current size".
+
+    The policy may assume: ``metrics`` is a plain dict sampled by the
+    caller (``step_time_s``, ``queue_depth`` — both optional), and the
+    returned size is a TARGET the controller clamps and executes. It
+    may NOT assume its decision is applied (``AUTODIST_MAX_WORKERS``
+    caps it, scale-down is recorded-but-unsupported) or that admitted
+    capacity arrives synchronously (a joiner takes an admit handshake
+    plus an XLA compile to contribute).
+    """
+    def policy(metrics, current_world):
+        step_s = metrics.get('step_time_s')
+        depth = metrics.get('queue_depth')
+        if step_time_target_s is not None and step_s is not None \
+                and step_s > step_time_target_s:
+            return current_world + grow_by
+        if queue_depth_max is not None and depth is not None \
+                and depth > queue_depth_max:
+            return current_world + grow_by
+        return None
+    return policy
+
+
+class AutoscaleController:
+    """The injectable autoscale policy hook (elastic scale-up's
+    decision layer): each :meth:`tick` samples caller-provided metrics,
+    asks the ``policy`` for a desired world size, clamps it to
+    ``AUTODIST_MAX_WORKERS`` and executes growth through the injected
+    ``scale_up`` callable (``Coordinator.scale_up`` in production, a
+    recorder in tests). Every decision — taken, skipped, capped or
+    failed — is recorded on :attr:`decisions` so
+    ``profiling.health_report`` can audit the autoscaler alongside the
+    recovery machinery.
+
+    Scale-DOWN is recorded as skipped, not executed: membership only
+    grows (the world counter is monotone); shrinking rides the
+    exclude-policy path when a worker actually leaves.
+    """
+
+    def __init__(self, policy, scale_up, current_world,
+                 max_workers=None, live_world=None):
+        self._policy = policy
+        self._scale_up = scale_up
+        self.world = current_world
+        self._max = max_workers if max_workers is not None \
+            else ENV.AUTODIST_MAX_WORKERS.val
+        # optional zero-arg callable returning live membership: each
+        # tick resyncs from it, so deaths hand their headroom back —
+        # a local-only world at the cap would otherwise skip forever
+        # after churn, and a launched-but-refused joiner would count
+        # as phantom capacity permanently
+        self._live_world = live_world
+        self.decisions = []
+
+    @property
+    def taken(self):
+        return sum(1 for d in self.decisions
+                   if d['action'] == 'scale_up')
+
+    @property
+    def skipped(self):
+        """Deliberate skips only — a FAILED scale-up is an
+        infrastructure error, not a policy decision, and the audit
+        trail must not launder one into the other."""
+        return sum(1 for d in self.decisions
+                   if d['action'] == 'skipped')
+
+    @property
+    def failed(self):
+        return sum(1 for d in self.decisions
+                   if d['action'] == 'failed')
+
+    def tick(self, metrics=None):
+        """One autoscale evaluation; returns the decision record."""
+        metrics = dict(metrics or {})
+        if self._live_world is not None:
+            try:
+                live = self._live_world()
+                if live:
+                    self.world = live
+            except Exception as e:  # noqa: BLE001 - resync is advisory
+                logging.warning('autoscale live-world resync failed: '
+                                '%s: %s', type(e).__name__, e)
+        desired = self._policy(metrics, self.world)
+        rec = {'world': self.world, 'metrics': metrics,
+               'desired': desired}
+        if desired is None or desired == self.world:
+            rec.update(action='skipped',
+                       reason='no_opinion' if desired is None
+                       else 'at_target')
+        elif desired < self.world:
+            rec.update(action='skipped',
+                       reason='scale_down_unsupported')
+        else:
+            granted = min(desired, self._max)
+            if granted <= self.world:
+                rec.update(action='skipped',
+                           reason='AUTODIST_MAX_WORKERS')
+            else:
+                try:
+                    asked = granted - self.world
+                    got = self._scale_up(asked)
+                    # believe what was actually LAUNCHED, not what was
+                    # asked: Coordinator.scale_up clamps against its
+                    # own live-membership room (possibly to zero) and
+                    # returns the supervisors it started — advancing
+                    # `world` past reality would make the controller
+                    # see phantom capacity and never fire again.
+                    # Contract: scale_up returns the launched
+                    # supervisors (list) or a count; a bare-None
+                    # return (a void callable) is trusted as fully
+                    # launched — pair such a callable with live_world
+                    # so reality resyncs each tick.
+                    launched = len(got) if isinstance(
+                        got, (list, tuple)) else (
+                        got if isinstance(got, int) else asked)
+                    if launched <= 0:
+                        rec.update(action='skipped',
+                                   reason='scale_up_launched_nothing')
+                    else:
+                        self.world += launched
+                        rec.update(action='scale_up',
+                                   granted=self.world,
+                                   launched=launched)
+                except Exception as e:  # noqa: BLE001 - recorded, the
+                    # autoscaler advising must not kill the run
+                    rec.update(action='failed',
+                               error='%s: %s' % (type(e).__name__, e))
+                    logging.warning('autoscale scale_up to %d failed: '
+                                    '%s', granted, rec['error'])
+        self.decisions.append(rec)
+        if rec['action'] == 'scale_up':
+            logging.info('autoscale: world %d -> %d (%s)',
+                         rec['world'], rec['granted'], metrics)
+        return rec
+
+
 # AUTODIST_COORD_TOKEN is deliberately NOT in _FORWARDED_FLAGS: env
 # assignments ride the remote ssh command line, which is world-readable
 # in `ps` on the worker host. The secret ships as a mode-0600 file
@@ -427,45 +578,148 @@ class Coordinator:
             'instead', policy)
         return 'fail'
 
+    def _launch_supervised(self, address, pid, policy, extra_env=None):
+        """Ship prerequisites to ``address`` and start ONE worker
+        process there (process id ``pid``) under a policy-aware
+        :class:`WorkerSupervisor`. Returns the supervisor (None in
+        debug-remote mode)."""
+        script = ' '.join(shlex.quote(a) for a in
+                          [sys.executable] + sys.argv)
+        max_restarts = ENV.AUTODIST_MAX_WORKER_RESTARTS.val
+        ssh_config = self._resource_spec.ssh_config(address)
+        self._copy_strategy(address, ssh_config)
+        self._copy_token(address, ssh_config)
+        env = self._worker_env(address, pid)
+        if extra_env:
+            env.update(extra_env)
+        env_str = ' '.join('%s=%s' % (k, shlex.quote(v))
+                           for k, v in env.items())
+        venv = ''
+        if ssh_config and ssh_config.python_venv:
+            venv = '. %s/bin/activate && ' % ssh_config.python_venv
+        remote_cmd = 'cd %s && %s%s %s' % (
+            shlex.quote(os.getcwd()), venv, env_str, script)
+        cmd = self._ssh_base(ssh_config) + \
+            [self._target(address, ssh_config), remote_cmd]
+        if ENV.AUTODIST_DEBUG_REMOTE.val:
+            logging.info('[debug-remote] %s', ' '.join(cmd))
+            return None
+
+        def spawn(cmd=cmd, address=address):
+            logging.info('Launching worker on %s', address)
+            return subprocess.Popen(cmd)
+
+        sup = WorkerSupervisor(
+            address, spawn, policy=policy,
+            max_restarts=max_restarts,
+            fence=lambda pid=pid: self._fence_worker(pid),
+            mark_failed=lambda pid=pid: self._mark_worker_failed(pid),
+            on_give_up=self._abort_chief,
+            is_shutting_down=lambda: self._shutting_down).start()
+        self.supervisors.append(sup)
+        return sup
+
     def launch_clients(self):
         """Re-run ``sys.argv`` on every non-chief replica host, each
         under a policy-aware :class:`WorkerSupervisor`."""
         chief = self._resource_spec.chief
         workers = [n for n in self._resource_spec.nodes if n != chief]
-        script = ' '.join(shlex.quote(a) for a in
-                          [sys.executable] + sys.argv)
         policy = self._effective_policy()
-        max_restarts = ENV.AUTODIST_MAX_WORKER_RESTARTS.val
         for i, address in enumerate(workers, start=1):
-            ssh_config = self._resource_spec.ssh_config(address)
-            self._copy_strategy(address, ssh_config)
-            self._copy_token(address, ssh_config)
-            env = self._worker_env(address, i)
-            env_str = ' '.join('%s=%s' % (k, shlex.quote(v))
-                               for k, v in env.items())
-            venv = ''
-            if ssh_config and ssh_config.python_venv:
-                venv = '. %s/bin/activate && ' % ssh_config.python_venv
-            remote_cmd = 'cd %s && %s%s %s' % (
-                shlex.quote(os.getcwd()), venv, env_str, script)
-            cmd = self._ssh_base(ssh_config) + \
-                [self._target(address, ssh_config), remote_cmd]
-            if ENV.AUTODIST_DEBUG_REMOTE.val:
-                logging.info('[debug-remote] %s', ' '.join(cmd))
-                continue
-
-            def spawn(cmd=cmd, address=address):
-                logging.info('Launching worker on %s', address)
-                return subprocess.Popen(cmd)
-
-            self.supervisors.append(WorkerSupervisor(
-                address, spawn, policy=policy,
-                max_restarts=max_restarts,
-                fence=lambda pid=i: self._fence_worker(pid),
-                mark_failed=lambda pid=i: self._mark_worker_failed(pid),
-                on_give_up=self._abort_chief,
-                is_shutting_down=lambda: self._shutting_down).start())
+            self._launch_supervised(address, i, policy)
+        self._next_pid = len(workers) + 1
         return self
+
+    def scale_up(self, count, addresses=None):
+        """Launch ``count`` ADDITIONAL workers into the RUNNING job —
+        the supervised half of elastic scale-up. Each new process
+        carries ``AUTODIST_ELASTIC_JOIN=1`` and admits itself at the
+        control plane (:func:`autodist_tpu.runtime.session.admit_worker`
+        claims its definitive worker slot there; the env process id is
+        advisory). ``addresses`` defaults to cycling the spec's nodes
+        (non-chief first), matching the reference's one-worker-per-host
+        layout while still allowing same-host growth.
+
+        Capped by ``AUTODIST_MAX_WORKERS`` against the pids this
+        coordinator has issued; the joiner's own admit claim enforces
+        the ceiling against the live world (a claim raced past the cap
+        is retired as excluded, so live membership never exceeds it).
+
+        Supervision policy: a scale-up worker is supervised under
+        ``exclude`` semantics whenever recovery is enabled — a dead
+        joiner's SLOT is excluded by the surviving peers and any
+        replacement re-JOINs as a fresh slot; re-binding the dead slot
+        (the ``restart`` path) would leave survivors waiting on a
+        counter no replacement will ever advance, because the monotone
+        world counter never re-issues ordinals. ``fail`` stays
+        fail-fast. Returns the new supervisors.
+        """
+        policy = self._effective_policy()
+        if policy == 'restart':
+            logging.info('scale-up workers are supervised under '
+                         'exclude semantics (a dead joiner re-admits '
+                         'as a fresh slot; its old slot is excluded '
+                         'by the peers)')
+            policy = 'exclude'
+        max_workers = ENV.AUTODIST_MAX_WORKERS.val
+        next_pid = getattr(self, '_next_pid',
+                           len(list(self._resource_spec.nodes)))
+        room = max(0, max_workers - self._live_world_estimate(next_pid))
+        if count > room:
+            logging.warning(
+                'scale_up(%d) clamped to %d: AUTODIST_MAX_WORKERS=%d '
+                'bounds the LIVE membership', count, room, max_workers)
+            count = room
+        if addresses is None:
+            chief = self._resource_spec.chief
+            nodes = list(self._resource_spec.nodes)
+            pool = [n for n in nodes if n != chief] or nodes
+            addresses = [pool[i % len(pool)] for i in range(count)]
+        new = []
+        for address in addresses[:count]:
+            pid = next_pid
+            next_pid += 1
+            sup = self._launch_supervised(
+                address, pid, policy,
+                extra_env={ENV.AUTODIST_ELASTIC_JOIN.name: '1'})
+            if sup is not None:
+                new.append(sup)
+        self._next_pid = next_pid
+        return new
+
+    def _live_world_estimate(self, fallback):
+        """Live membership (claimed ordinals minus excluded) read from
+        the coord service, so exclusions hand their cap headroom back
+        — a churny long-running job must not ratchet itself below the
+        ceiling it is allowed to refill. Falls back to the issued-pid
+        count when the service is unreachable (the joiner's own admit
+        claim enforces the ceiling authoritatively either way)."""
+        from autodist_tpu.runtime import coord_client as cc
+        from autodist_tpu.runtime.session import live_members_on_plane
+        try:
+            host, port = self._coord_service_targets()[0]
+            client = cc.CoordClient((host, port), timeout=2.0)
+            try:
+                live, world, _ = live_members_on_plane(
+                    client, self._strategy.id)
+                return live if world > 0 else fallback
+            finally:
+                client.close()
+        except OSError:
+            return fallback
+
+    def autoscaler(self, policy):
+        """An :class:`AutoscaleController` wired to this coordinator:
+        its decisions execute through :meth:`scale_up`, starting from
+        the worker ordinals this coordinator has already issued (NOT
+        the launch node count — a manual ``scale_up`` call before the
+        controller exists must not read as phantom headroom)."""
+        fallback = getattr(self, '_next_pid',
+                           len(list(self._resource_spec.nodes)))
+        return AutoscaleController(
+            policy, self.scale_up, current_world=fallback,
+            live_world=lambda: self._live_world_estimate(
+                getattr(self, '_next_pid', fallback)))
 
     def join(self):
         for s in self.supervisors:

@@ -98,6 +98,146 @@ def assign_ps_endpoints(var_plans, endpoints):
     return out
 
 
+def live_members_on_plane(coord, ns):
+    """THE live-membership definition for namespace ``ns`` — claimed
+    ordinals minus excluded slots — as ``(live, world, excluded)``.
+    :func:`admit_worker`'s cap check and the coordinator's scale-up
+    clamp (``Coordinator._live_world_estimate``) both ride this one
+    implementation: if the definition ever changes (e.g. counting
+    done/ markers), they must move together or the clamp and the
+    authoritative admit-time refusal silently disagree."""
+    world = coord.incr('%s/join/world' % ns, 0)
+    excluded = sum(
+        1 for i in range(world)
+        if coord.incr('excluded/%s/p%d' % (ns, i), 0) > 0)
+    return world - excluded, world, excluded
+
+
+def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
+                 launch_workers=None):
+    """The live scale-UP admit handshake: join worker ``coord`` into the
+    RUNNING loose-mode namespace ``ns`` (the second half of elasticity —
+    PR 4 made workers *leaving* survivable; this makes joining possible).
+
+    One protocol, one place: :class:`Session` joins through it when
+    ``AUTODIST_ELASTIC_JOIN`` is set, and chaos tests / ``bench.py``'s
+    elastic A/B drive it with a raw client — the handshake must not be
+    re-implemented per caller or the fault-injection coverage
+    (``faultline``'s ``join_*`` kinds) stops meaning anything.
+
+    Ordering is the contract (each step's placement matters):
+
+    1. wait for ``<ns>/session/init-done`` — a join is only legal
+       against a cohort whose init rendezvous completed (the world
+       counter is only guaranteed seeded after it, and the chief clears
+       stale markers before it).
+    2. claim a worker slot: an atomic ``INCR`` of ``<ns>/join/world``
+       (the same counter the launch cohort seeded to its quorum — no
+       new service atomic needed). Refused when the claim would exceed
+       ``AUTODIST_MAX_WORKERS``.
+    3. bind the slot's fence generation BEFORE any namespace write, so
+       every admit-path write is already fenceable: a joiner declared
+       dead mid-admit is rejected exactly like any other zombie.
+    4. compute the adopted step FLOOR: the min of live members'
+       published steps (``CLEAN_CLOSE_STEP`` releases and never-
+       published zeros skipped) — the one value that neither blocks the
+       cohort's staleness gates (a join at step 0 would stall everyone
+       at ``floor + staleness``) nor claims progress ahead of any peer.
+    5. bump ``<ns>/epoch`` — MEMBERSHIP BECOMES VISIBLE FIRST, then
+       the floor is published and the heartbeat baseline laid down.
+       This order is the one whose failure window SELF-HEALS: a joiner
+       dying after the bump is a visible member with no step/beat,
+       which the never-beat rule declares dead and the exclude path
+       releases within one heartbeat window. The reverse order
+       (step counter before membership) leaves an INVISIBLE frozen
+       counter inside the gate's prefix-min that no survivor can ever
+       exclude — a permanent cohort stall with no recovery path.
+
+    Returns ``{'worker_id', 'worker', 'world', 'generation',
+    'adopted_step', 'epoch', 'admit_wall_s'}``.
+    """
+    import time as _time
+    from autodist_tpu.runtime.coord_client import CLEAN_CLOSE_STEP
+    if max_workers is None:
+        max_workers = ENV.AUTODIST_MAX_WORKERS.val
+    t0 = _time.monotonic()
+    coord.wait_key('%s/session/init-done' % ns, timeout_s=wait_init_s)
+    world_key = '%s/join/world' % ns
+    # the cap bounds LIVE membership, not cumulative ordinals: the
+    # monotone counter never decrements, so dead (excluded) workers
+    # must hand their headroom back or a long-running job with churn
+    # would ratchet itself below the ceiling it is allowed to refill.
+    # (One serial INCR per ordinal: at the default 64-worker cap this
+    # is a handful of round-trips paid once per admit, not per step.)
+    live, before, excluded_n = live_members_on_plane(coord, ns)
+    if launch_workers and before < launch_workers:
+        raise RuntimeError(
+            'cannot join namespace %s: its world counter (%d) is below '
+            'the launch quorum (%d) — the cohort never seeded it (a '
+            'stale init-done marker on a reused service, or not an '
+            'elastic-capable run)' % (ns, before, launch_workers))
+    if live >= max_workers:
+        raise RuntimeError(
+            'cannot join namespace %s: live membership (%d of %d '
+            'claimed slots) is already at AUTODIST_MAX_WORKERS=%d'
+            % (ns, live, before, max_workers))
+    world = coord.incr(world_key, 1)
+    worker_id = world - 1
+    worker = 'p%d' % worker_id
+    if world - excluded_n > max_workers:
+        # the cap read above and the claim are separate RPCs, so two
+        # concurrent joiners can both pass the pre-check; the LAST
+        # claim lands over the cap. The claim cannot be rolled back
+        # (the monotone counter never re-issues ordinals — a decrement
+        # would hand the next joiner a colliding slot), so retire the
+        # slot as already-excluded + released: any survivor that ever
+        # sees it skips it without paying a heartbeat window, and the
+        # live membership never exceeds the cap.
+        coord.incr('excluded/%s/%s' % (ns, worker), 1)
+        coord.publish_step(worker, CLEAN_CLOSE_STEP,
+                           prefix='%s/step/' % ns)
+        raise RuntimeError(
+            'cannot join namespace %s: a concurrent join raced this '
+            'claim past AUTODIST_MAX_WORKERS=%d (slot %s retired as '
+            'excluded)' % (ns, max_workers, worker))
+    # fence binding precedes every namespace write below; generation>0
+    # means this SLOT was admitted before and its holder declared dead
+    # (slots are never re-issued by the monotone world counter, so that
+    # only happens to a supervised re-admit of this same joiner).
+    fence_key = 'fence/%s/%s' % (ns, worker)
+    generation = coord.incr(fence_key, 0)
+    coord.fence(fence_key, generation)
+    floor = None
+    for i in range(worker_id):
+        step = coord.incr('%s/step/p%d' % (ns, i), 0)
+        if step == 0 or step >= CLEAN_CLOSE_STEP:
+            # never-published (a half-admitted ghost, or a cohort still
+            # at step 0 — then every member reads 0 and the floor
+            # degrades to 0 anyway) or a departed worker's release
+            continue
+        floor = step if floor is None else min(floor, step)
+    # a crashed-but-not-yet-excluded peer can still be in this min, but
+    # the staleness gate bounds how stale: every live counter (and so
+    # any recent corpse's) is within gate_staleness of the cohort's
+    # front, so adopting it costs the joiner at most `staleness` extra
+    # catch-up steps — never a cohort stall
+    floor = floor or 0
+    # epoch bump BEFORE the step publish (see step 5 above): every
+    # post-claim death must leave a VISIBLE member the exclusion
+    # machinery can clean up, never an invisible counter it cannot
+    epoch = coord.incr('%s/epoch' % ns, 1)
+    coord.publish_step(worker, floor, prefix='%s/step/' % ns)
+    coord.heartbeat('%s/%s' % (ns, worker))
+    wall = _time.monotonic() - t0
+    logging.info(
+        'admitted %s into %s at epoch %d: world %d -> %d, adopted step '
+        'floor %d, generation %d (%.3fs)', worker, ns, epoch, before,
+        world, floor, generation, wall)
+    return {'worker_id': worker_id, 'worker': worker, 'world': world,
+            'generation': generation, 'adopted_step': floor,
+            'epoch': epoch, 'admit_wall_s': wall}
+
+
 class Session:
     """Stateful driver over the functional compiled step.
 
@@ -124,12 +264,42 @@ class Session:
         self._step_count = 0
         self._closed = False
         self._loose = plan.loose
-        self._num_workers = ENV.AUTODIST_NUM_PROCESSES.val
-        self._worker_name = 'p%d' % ENV.AUTODIST_PROCESS_ID.val
-        self._is_chief = not ENV.AUTODIST_WORKER.val
+        # namespace coord-service keys by strategy id: a reused/leaked
+        # service must not serve a previous run's vars or step counters.
+        # (Assigned before identity: the elastic admit below claims a
+        # worker slot under this namespace.)
+        self._ns = getattr(plan.strategy, 'id', 'default')
         if self._loose and coord is None:
             raise RuntimeError('loose multi-process mode needs a coord '
                                'service client')
+        # -- elastic scale-UP: live JOIN into a running namespace ----------
+        # AUTODIST_ELASTIC_JOIN marks this process as a joiner: it was
+        # not part of the launch cohort, so its definitive identity is
+        # the slot the admit handshake claims — the spawner's env
+        # process id is advisory only. The env is rewritten to the
+        # claimed slot so everything downstream (worker name, heartbeat
+        # peers, pipeline floor loops) agrees with the control plane.
+        self._joining = False
+        self._admit = None
+        if self._loose and ENV.AUTODIST_ELASTIC_JOIN.val:
+            # launch_workers guards the never-seeded case: a stale
+            # init-done marker on a reused service must refuse the
+            # join, not hand out a launch-cohort ordinal (read BEFORE
+            # the identity env rewrite below)
+            self._admit = admit_worker(
+                coord, self._ns,
+                launch_workers=ENV.AUTODIST_NUM_PROCESSES.val)
+            os.environ[ENV.AUTODIST_PROCESS_ID.name] = \
+                str(self._admit['worker_id'])
+            os.environ[ENV.AUTODIST_NUM_PROCESSES.name] = \
+                str(self._admit['world'])
+            self._joining = True
+        self._num_workers = ENV.AUTODIST_NUM_PROCESSES.val
+        self._worker_name = 'p%d' % ENV.AUTODIST_PROCESS_ID.val
+        # a joiner is never the chief: the chief seeded the PS and owns
+        # the cohort rendezvous — a joiner consumes both
+        self._is_chief = not ENV.AUTODIST_WORKER.val and \
+            not self._joining
         # Bucketed AllReduce sync (plan.sync_gradients) only overlaps
         # the backward pass if XLA is allowed to schedule the bucket
         # collectives asynchronously — arm the latency-hiding flags
@@ -144,9 +314,6 @@ class Session:
             if applied:
                 logging.info('Gradient bucketing active: armed XLA '
                              'overlap flags %s', applied)
-        # namespace coord-service keys by strategy id: a reused/leaked
-        # service must not serve a previous run's vars or step counters
-        self._ns = getattr(plan.strategy, 'id', 'default')
         # -- elastic recovery (epoch-fenced membership) --------------------
         # Peer-failure policy: what a survivor does when a peer misses
         # heartbeats (fail = raise, exclude = fence + shrink membership,
@@ -159,10 +326,19 @@ class Session:
         self._generation = 0        # this worker's fencing generation
         self._fence_key = ''
         self._rejoining = False
+        # live world size: the launch quorum GROWN by admitted joiners
+        # (the <ns>/join/world counter). Every membership-derived
+        # quantity — gate party counts, the AUTODIST_MIN_WORKERS floor,
+        # pipeline peer floors, the close() purge quorum — re-evaluates
+        # against this, never the launch-time count.
+        self._world = self._num_workers
         self._health = {'policy': self._policy, 'missed_beats': 0,
                         'epoch_bumps': 0, 'exclusions': [],
                         'rejoins': [], 'recovery_wall_s': [],
+                        'joins': [], 'replans': [],
                         'auto_checkpoints': 0}
+        if self._joining:
+            self._health['admitted'] = dict(self._admit)
         if self._loose:
             # every write this process makes rides connections bound to
             # its fencing generation: once a survivor (or the restart
@@ -177,10 +353,33 @@ class Session:
             # generation > 0 means a previous incarnation was declared
             # dead: this process is its supervised replacement and must
             # REJOIN (skip the init barrier nobody else attends, pull
-            # current params from the PS, resume at the published step)
-            self._rejoining = self._generation > 0
+            # current params from the PS, resume at the published step).
+            # A live JOINer claims a fresh slot (generation 0) instead.
+            self._rejoining = self._generation > 0 and not self._joining
+            if self._is_chief and not self._rejoining:
+                # a reused service may hold a PREVIOUS run's init-done
+                # marker (deterministic strategy id, crashed run whose
+                # close-purge never ran): left in place, a joiner
+                # launched before this chief could admit against the
+                # stale world counter and collide with the reset below
+                # — delete it FIRST (it is re-published only after this
+                # run's rendezvous completes). The residual window (a
+                # joiner passing wait_key before this delete) requires
+                # joiners launched before the run they join, which the
+                # scale-up paths never do.
+                self._coord.delete(self._key('session/init-done'))
+                # seed the elastic world counter to the launch quorum
+                # BEFORE the init rendezvous (admits wait for the
+                # init-done marker, so no join can race this). A stale
+                # counter on a reused service is forced back to the
+                # quorum — joins are only legal against live state.
+                cur = coord.incr(self._key('join/world'), 0)
+                if cur != self._num_workers:
+                    coord.incr(self._key('join/world'),
+                               self._num_workers - cur)
             self._epoch_seen = coord.incr(self._key('epoch'), 0)
-            self._refresh_membership()
+            self._refresh_membership(
+                adopt_growth=self._rejoining or self._joining)
             if self._rejoining:
                 self._step_count = coord.incr(
                     self._key('step/') + self._worker_name, 0)
@@ -188,6 +387,10 @@ class Session:
                     'rejoining as %s under generation %d at published '
                     'step %d (membership epoch %d)', self._worker_name,
                     self._generation, self._step_count, self._epoch_seen)
+            elif self._joining:
+                # the admit handshake already published this floor; the
+                # session resumes counting from it
+                self._step_count = self._admit['adopted_step']
         # chief-side auto-checkpoint backstop: with restarts in play the
         # PS state is authoritative, but a periodic chief snapshot
         # bounds the blast radius of losing the PS itself
@@ -290,12 +493,14 @@ class Session:
         # beater decouples it from step cadence — a long XLA compile or
         # an inter-run data-loading phase must not read as death.
         self._hb_seen = {}
-        self._hb_peers = [
-            self._key('p%d' % i) for i in range(self._num_workers)
-            if i != ENV.AUTODIST_PROCESS_ID.val]
+        self._rebuild_hb_peers()   # over the LIVE world, not the quorum
         self._hb_stop = None
         hb_timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
-        if self._loose and self._num_workers > 1 and hb_timeout:
+        # armed whenever heartbeats are on, even alone at launch: a
+        # 1-process namespace can GROW (live join), and the joiner
+        # would judge this process by a beat counter nobody advances
+        # between steps — a long XLA recompile would then read as death
+        if self._loose and hb_timeout:
             import threading
             self._hb_stop = threading.Event()
             me = self._key(self._worker_name)
@@ -433,16 +638,86 @@ class Session:
         return self._coord.incr(self._key('step/') + 'p%d' % process_id, 0)
 
     def _active_workers(self):
-        """Current gate membership size (self-inclusive): the launch
-        quorum minus peers excluded under the ``exclude`` policy."""
-        return self._num_workers - len(self._excluded)
+        """Current gate membership size (self-inclusive): the LIVE
+        world (launch quorum + admitted joiners) minus peers excluded
+        under the ``exclude`` policy — re-evaluated per gate slice, so
+        both shrinks and grows reach a blocked waiter mid-wait."""
+        return self._world - len(self._excluded)
 
-    def _refresh_membership(self):
-        """Adopt exclusions recorded on the control plane. Membership
-        is DERIVED from per-worker excluded markers (atomic counters),
-        never a read-modify-write list, so two survivors excluding two
-        different peers concurrently cannot lose each other's update."""
-        for i in range(self._num_workers):
+    def _live_members(self):
+        """Worker ordinals currently in the membership (excluded peers
+        dropped) — the set gate bounds and pipeline peer floors range
+        over."""
+        return [i for i in range(self._world)
+                if self._key('p%d' % i) not in self._excluded]
+
+    def _rebuild_hb_peers(self):
+        me = ENV.AUTODIST_PROCESS_ID.val
+        self._hb_peers = [self._key('p%d' % i)
+                          for i in range(self._world) if i != me]
+
+    def _refresh_membership(self, adopt_growth=True):
+        """Adopt membership changes recorded on the control plane, in
+        BOTH directions. Grows: the ``join/world`` counter advanced by
+        admitted joiners (each already publishing a step counter and a
+        beat before its epoch bump made it observable — see
+        :func:`admit_worker`); the heartbeat peer list and, on the
+        chief, the strategy re-rank (:meth:`_replan_for_world`) follow.
+        Shrinks: per-worker excluded markers (atomic counters), never a
+        read-modify-write list, so two survivors excluding two
+        different peers concurrently cannot lose each other's update.
+
+        ``adopt_growth=False`` is the FRESH-cohort init call: a reused
+        service can hold a crashed previous run's larger counter, and
+        no join can legitimately precede this run's rendezvous (admits
+        wait for the init-done marker every cohort member's epoch
+        baseline is read before), so a fresh member adopting a bigger
+        world at init would be adopting phantom members — it starts at
+        the launch quorum and learns real growth from epoch bumps.
+        Rejoining replacements and live joiners DO adopt at init: the
+        world they re-enter may legitimately have grown."""
+        world = self._coord.incr(self._key('join/world'), 0)
+        if adopt_growth and world > self._world:
+            fresh = 0
+            for i in range(self._world, world):
+                wkey = self._key('p%d' % i)
+                if self._coord.incr('excluded/%s' % wkey, 0) > 0:
+                    # a slot retired at admit time (a claim raced past
+                    # AUTODIST_MAX_WORKERS) or already excluded: it was
+                    # never a live join and must not inflate the audit
+                    # trail or trigger a re-rank
+                    self._excluded.add(wkey)
+                    continue
+                fresh += 1
+                self._health['joins'].append(
+                    {'worker': 'p%d' % i, 'epoch': self._epoch_seen})
+            if fresh:
+                logging.info(
+                    'membership grew: %d worker(s) joined at epoch %d '
+                    '(world %d -> %d)', fresh, self._epoch_seen,
+                    self._world, world)
+            self._world = world
+            self._rebuild_hb_peers()
+            if self._is_chief and fresh:
+                # OFF the gate's critical path: this runs inside the
+                # staleness gate's failure_check, where a synchronous
+                # candidate enumeration would stall the chief's step
+                # publishing — and with it every peer blocked on the
+                # chief's counter. The re-rank is pure bookkeeping into
+                # _health, so it rides a daemon thread; health_stats
+                # joins it before reporting.
+                import threading
+                t = threading.Thread(
+                    target=self._replan_for_world, args=(world,),
+                    daemon=True, name='autodist-replan')
+                # a LIST, not a slot: a second grow while the first
+                # re-rank still runs must not orphan it — health_stats
+                # joins them all before reporting
+                if not hasattr(self, '_replan_threads'):
+                    self._replan_threads = []
+                self._replan_threads.append(t)
+                t.start()
+        for i in range(self._world):
             w = 'p%d' % i
             wkey = self._key(w)
             if wkey in self._excluded:
@@ -455,6 +730,51 @@ class Session:
                 'the run at epoch %d; its writes are fenced — exiting '
                 'instead of training into rejected pushes'
                 % (self._worker_name, self._epoch_seen))
+
+    def _replan_for_world(self, world):
+        """On admit, re-rank strategies for the NEW world size with the
+        simulator (``AutoStrategy`` over the grown replica count) and
+        record the predicted-vs-kept decision. Execution KEEPS the
+        current plan: moving live state between strategy layouts needs
+        the device-side resharding path (ROADMAP item 3), so this is
+        the audit trail that migration would have paid off — never a
+        behavior change, and never fatal (a re-rank failure must not
+        take down the training it advises)."""
+        entry = {'world': world,
+                 'kept': dict(getattr(self._plan.strategy, 'cost', None)
+                              or {}).get('builder', ''),
+                 'migrated': False}
+        try:
+            rs = getattr(self._cluster, '_resource_spec', None)
+            if rs is None:
+                entry['skipped'] = 'no resource spec on the cluster'
+            else:
+                from autodist_tpu.strategy.builders import AutoStrategy
+                auto = AutoStrategy(
+                    num_replicas=world * max(1, self._plan.local_replicas))
+                best = auto.build(self._graph_item, rs)
+                cost = dict(getattr(best, 'cost', None) or {})
+                entry['predicted'] = cost.get('builder', '')
+                entry['predicted_step_time_s'] = \
+                    cost.get('predicted_step_time_s')
+                kept_rank = next(
+                    (c.report.predicted_step_time_s
+                     for c in auto.last_ranked
+                     if c.name == entry['kept'] and c.report is not None),
+                    None)
+                entry['kept_predicted_step_time_s'] = kept_rank
+                logging.info(
+                    're-ranked strategies for world=%d: predicted best '
+                    '%s (%.4gs/step), kept %s — live migration needs '
+                    'the resharding path (ROADMAP item 3)', world,
+                    entry['predicted'],
+                    entry['predicted_step_time_s'] or float('nan'),
+                    entry['kept'] or '(hand-picked)')
+        except Exception as e:  # noqa: BLE001 - advisory, never fatal
+            entry['error'] = '%s: %s' % (type(e).__name__, e)
+            logging.warning('strategy re-rank for world=%d failed: %s',
+                            world, entry['error'])
+        self._health['replans'].append(entry)
 
     def _exclude_peer(self, wkey, timeout):
         """Epoch-fenced exclusion of a dead peer. Every detector fences
@@ -518,13 +838,11 @@ class Session:
         (epoch bump + generation fencing); ``restart`` keeps waiting
         for the coordinator-supervised replacement."""
         import time as _time
-        timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
-        if not timeout:
-            return
-        # belt and braces alongside the background beater: a waiter is
-        # trivially alive, refresh our beat on every gate slice too
-        self._coord.heartbeat(self._key(self._worker_name))
-        # adopt membership changes other survivors fenced in
+        # adopt membership changes FIRST — exclusions other survivors
+        # fenced in AND joins (the epoch bump is how an admitted worker
+        # becomes visible). This runs even with heartbeats disabled:
+        # the gate's party count must grow for a join regardless of
+        # whether failure DETECTION is armed.
         epoch = self._coord.incr(self._key('epoch'), 0)
         if epoch != self._epoch_seen:
             self._health['epoch_bumps'] += epoch - self._epoch_seen
@@ -533,6 +851,12 @@ class Session:
             logging.warning('membership epoch advanced to %d: %d '
                             'active workers', epoch,
                             self._active_workers())
+        timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
+        if not timeout:
+            return
+        # belt and braces alongside the background beater: a waiter is
+        # trivially alive, refresh our beat on every gate slice too
+        self._coord.heartbeat(self._key(self._worker_name))
         peers = [w for w in self._hb_peers if w not in self._excluded]
         dead = self._coord.dead_workers(peers, timeout, self._hb_seen)
         if dead:
@@ -733,13 +1057,21 @@ class Session:
         if it did would be misleading."""
         if not self._loose:
             return {}
+        # strategy re-ranks may still be running on their background
+        # threads (spawned from the gate's failure_check): join them
+        # all so the report never misses a decision it exists to audit
+        for t in getattr(self, '_replan_threads', ()):
+            if t.is_alive():
+                t.join(timeout=60.0)
         out = dict(self._health)
         out.update(
             epoch=self._epoch_seen,
             generation=self._generation,
             rejoining=self._rejoining,
+            joining=self._joining,
             num_workers=self._num_workers,
-            active_workers=self._num_workers - len(self._excluded),
+            world=self._world,
+            active_workers=self._active_workers(),
             excluded=sorted(w.rsplit('/', 1)[-1]
                             for w in self._excluded))
         return out
@@ -846,7 +1178,10 @@ class Session:
             # heartbeat baseline BEFORE the barrier: once any gate runs,
             # every peer has a timestamp (a missing one reads as dead)
             self._coord.heartbeat(self._key(self._worker_name))
-            if not self._rejoining:
+            if not (self._rejoining or self._joining):
+                # a live JOINer is never a barrier party: its admit
+                # handshake already waited for the init-done marker, so
+                # the rendezvous below completed before it could exist
                 self._coord.barrier(self._key('session/init'),
                                     self._num_workers, timeout_s=120.0)
                 if self._is_chief:
@@ -1148,7 +1483,10 @@ class Session:
                     _time.perf_counter() - t0
             return
 
-        num_workers = self._num_workers
+        # snapshot the LIVE membership (launch quorum + joins, minus
+        # exclusions) — the floor must range over every worker the next
+        # gate will count, not the launch-time list
+        members = self._live_members()
 
         def job(client):
             self._push_ps_deltas(pulled, shared_values())
@@ -1160,9 +1498,8 @@ class Session:
             # the next step's staleness bound and discards the prefetch
             # if it was taken too early — the pipeline must never serve
             # values staler than the gate guarantees.
-            floor = step if num_workers <= 1 else min(
-                client.incr(prefix + 'p%d' % i, 0)
-                for i in range(num_workers))
+            floor = step if len(members) <= 1 else min(
+                client.incr(prefix + 'p%d' % i, 0) for i in members)
             to_fetch = self._pull_to_fetch()
             parts, wire_s = self._fetch_var_parts(to_fetch)
             return {'names': to_fetch, 'parts': parts,

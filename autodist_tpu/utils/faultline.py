@@ -36,6 +36,21 @@ Fault kinds (each a dict in ``FaultPlan.faults``):
   (a ranged B* frame with offset > 0) is held for ``seconds`` before
   sending: readers see odd version parity that eventually resolves —
   the slow-but-alive writer the stall-timeout logic must NOT kill.
+- ``join_drop`` / ``join_delay`` / ``join_kill`` ``{at, seconds,
+  mode}`` — the admit-handshake faults (live scale-up,
+  ``runtime/session.py admit_worker``): the ``at``-th frame of THIS
+  process's join handshake (default match ``join/`` — the world-claim
+  INCRs; override ``match`` to target the step adoption or the epoch
+  bump) is dropped (OSError), delayed, or is the process's death point
+  (``exit`` = ``os._exit``, the real killed-mid-admit; ``raise`` =
+  :class:`InjectedFault` for in-process tests). The membership
+  machinery must absorb all three, and the handshake's epoch-bump-
+  before-step-publish ordering makes every window benign: a death
+  BEFORE the epoch bump leaves an invisible leaked ordinal with no
+  step counter (harmless — nothing of it reaches any gate), a death
+  AFTER it leaves a visible member with no beat, which the never-beat
+  rule declares dead and the exclude path releases within one
+  heartbeat window.
 
 Frame counts, step thresholds and the plan seed make every fault
 deterministic; ``FaultPlan.random`` derives a full plan from one seed
@@ -56,7 +71,12 @@ from autodist_tpu.const import ENV
 from autodist_tpu.utils import logging
 
 FAULT_KINDS = ('kill_worker', 'drop_conn', 'close_conn', 'delay_conn',
-               'torn_frame', 'stalled_writer')
+               'torn_frame', 'stalled_writer', 'join_drop',
+               'join_delay', 'join_kill')
+
+# the join_* kinds default their match to the admit handshake's
+# world-claim frames; no field is strictly required
+JOIN_MATCH_DEFAULT = 'join/'
 
 _REQUIRED = {
     'kill_worker': ('worker', 'step'),
@@ -65,6 +85,9 @@ _REQUIRED = {
     'delay_conn': ('match',),
     'torn_frame': ('match',),
     'stalled_writer': ('match',),
+    'join_drop': (),
+    'join_delay': (),
+    'join_kill': (),
 }
 
 
@@ -142,6 +165,14 @@ class FaultPlan:
                                'match': 'BSET', 'at': at,
                                'seconds': 0.1 * (1 + int(
                                    rng.randint(3)))})
+            elif kind.startswith('join_'):
+                f = {'kind': kind, 'worker': worker,
+                     'at': 1 + int(rng.randint(2))}
+                if kind == 'join_delay':
+                    f['seconds'] = 0.02 * (1 + int(rng.randint(4)))
+                elif kind == 'join_kill':
+                    f['mode'] = 'raise'
+                faults.append(f)
             else:   # drop_conn / close_conn / torn_frame
                 faults.append({'kind': kind, 'worker': worker,
                                'match': 'BADD', 'at': at})
@@ -272,7 +303,11 @@ class FaultLine:
             # names a worker
             if fault.get('worker') and fault['worker'] != self.worker:
                 continue
-            if fault.get('match', '') not in line:
+            # join_* kinds default their match to the admit handshake's
+            # world-claim frames (session.admit_worker)
+            match = fault.get('match') or (
+                JOIN_MATCH_DEFAULT if kind.startswith('join_') else '')
+            if match not in line:
                 continue
             if kind == 'stalled_writer':
                 off = _continuation_offset(line)
@@ -284,6 +319,21 @@ class FaultLine:
                 continue
             self._fired.add(idx)
             self._record(fault, line)
+            if kind == 'join_drop':
+                raise OSError('faultline: dropped join-handshake frame '
+                              '%r' % line[:64])
+            if kind == 'join_kill':
+                if fault.get('mode', 'exit') == 'raise':
+                    raise InjectedFault(
+                        'faultline: worker killed mid-admit (frame %r)'
+                        % line[:64])
+                logging.warning('faultline: hard-killing worker during '
+                                'the admit handshake (frame %r)',
+                                line[:64])
+                os._exit(int(fault.get('exit_code', 137)))
+            if kind == 'join_delay':
+                time.sleep(float(fault.get('seconds', 0.05)))
+                continue
             if kind == 'drop_conn':
                 raise OSError('faultline: dropped connection before %r'
                               % line.split()[0])

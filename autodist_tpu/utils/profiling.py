@@ -266,18 +266,24 @@ def format_ps_sparse(report):
                        100.0 * report.get('avoided_frac', 0.0)))
 
 
-def health_report(health_stats, faultline=None):
-    """Recovery observability: one record per run of everything the
-    elastic-recovery machinery did — so every recovery is auditable,
-    not anecdotal.
+def health_report(health_stats, faultline=None, autoscale=None):
+    """Recovery + elasticity observability: one record per run of
+    everything the elastic machinery did — so every recovery AND every
+    membership change is auditable, not anecdotal.
 
     ``health_stats`` is :attr:`Session.health_stats` (policy, fencing
-    generation, membership epoch, missed beats, exclusions, rejoins,
-    recovery wall times, auto-checkpoints). ``faultline`` is an armed
-    :class:`~autodist_tpu.utils.faultline.FaultLine` (or its ``events``
-    list) whose injected faults are attached, so a chaos run's report
-    pairs "what was injected" with "what the runtime did about it".
-    Connection-retry counts come from the process-wide
+    generation, membership epoch, live world size, missed beats,
+    exclusions, rejoins, recovery wall times, observed joins, the
+    session's own admit record when it live-JOINed, the chief's
+    strategy re-rank decisions, auto-checkpoints). ``faultline`` is an
+    armed :class:`~autodist_tpu.utils.faultline.FaultLine` (or its
+    ``events`` list) whose injected faults are attached — join-path
+    faults (the ``join_*`` kinds) are also counted separately, so a
+    chaos run's report pairs "what was injected on the admit handshake"
+    with "what membership did about it". ``autoscale`` is an
+    :class:`~autodist_tpu.runtime.coordinator.AutoscaleController` (or
+    its ``decisions`` list): decisions taken and skipped ride the
+    report. Connection-retry counts come from the process-wide
     ``coord_client.RETRY_STATS``.
 
     Returns ``{}`` when the session never ran in loose mode (no
@@ -289,13 +295,17 @@ def health_report(health_stats, faultline=None):
         return {}
     events = faultline if isinstance(faultline, (list, tuple)) \
         else getattr(faultline, 'events', [])
+    decisions = autoscale if isinstance(autoscale, (list, tuple)) \
+        else list(getattr(autoscale, 'decisions', ()))
     recovery = list(hs.get('recovery_wall_s', ()))
+    admitted = hs.get('admitted')
     return {
         'policy': hs.get('policy', 'fail'),
         'generation': hs.get('generation', 0),
         'epoch': hs.get('epoch', 0),
         'epoch_bumps': hs.get('epoch_bumps', 0),
         'num_workers': hs.get('num_workers', 1),
+        'world': hs.get('world', hs.get('num_workers', 1)),
         'active_workers': hs.get('active_workers',
                                  hs.get('num_workers', 1)),
         'missed_beats': hs.get('missed_beats', 0),
@@ -304,11 +314,31 @@ def health_report(health_stats, faultline=None):
         'restarts_observed': len(hs.get('rejoins', ())),
         'recovery_wall_s': recovery,
         'max_recovery_wall_s': max(recovery) if recovery else 0.0,
+        # elastic scale-up: joins this process OBSERVED (epoch at
+        # admission), its own admit record (wall time) if it joined,
+        # and the chief's predicted-vs-kept re-rank decisions
+        'joins': list(hs.get('joins', ())),
+        'admitted': dict(admitted) if admitted else None,
+        'admit_wall_s': (admitted or {}).get('admit_wall_s', 0.0),
+        'replans': list(hs.get('replans', ())),
+        'autoscale': {
+            'decisions': decisions,
+            'taken': sum(1 for d in decisions
+                         if d.get('action') == 'scale_up'),
+            # deliberate skips and infrastructure failures are
+            # DIFFERENT audit outcomes — never lump them
+            'skipped': sum(1 for d in decisions
+                           if d.get('action') == 'skipped'),
+            'failed': sum(1 for d in decisions
+                          if d.get('action') == 'failed'),
+        },
         'auto_checkpoints': hs.get('auto_checkpoints', 0),
         'connect_retries': RETRY_STATS['connect_retries'],
         'injected_faults': [
             {'kind': e['kind'], 'line': e.get('line', '')}
             for e in events],
+        'injected_join_faults': sum(
+            1 for e in events if e['kind'].startswith('join_')),
     }
 
 
@@ -316,13 +346,37 @@ def format_health(report):
     """Human-readable rendering of :func:`health_report`."""
     if not report:
         return '(no loose-mode session: nothing to report)'
-    lines = ['policy=%s generation=%d epoch=%d  membership %d/%d'
+    lines = ['policy=%s generation=%d epoch=%d  membership %d/%d '
+             '(world %d)'
              % (report['policy'], report['generation'], report['epoch'],
-                report['active_workers'], report['num_workers'])]
+                report['active_workers'], report['num_workers'],
+                report.get('world', report['num_workers']))]
     lines.append('  missed beats: %d   connect retries: %d   '
                  'auto-checkpoints: %d'
                  % (report['missed_beats'], report['connect_retries'],
                     report['auto_checkpoints']))
+    if report.get('admitted'):
+        adm = report['admitted']
+        lines.append('  joined as %s at epoch %d (admit %.3fs, adopted '
+                     'step %d)' % (adm.get('worker'),
+                                   adm.get('epoch', -1),
+                                   adm.get('admit_wall_s', 0.0),
+                                   adm.get('adopted_step', 0)))
+    for j in report.get('joins', ()):
+        lines.append('  observed join: %s at epoch %d'
+                     % (j.get('worker'), j.get('epoch', -1)))
+    for r in report.get('replans', ()):
+        lines.append('  replan @world=%d: predicted %s vs kept %s%s'
+                     % (r.get('world', -1),
+                        r.get('predicted', '?'),
+                        r.get('kept') or '(hand-picked)',
+                        ' [error: %s]' % r['error']
+                        if r.get('error') else ''))
+    auto = report.get('autoscale') or {}
+    if auto.get('decisions'):
+        lines.append('  autoscale: %d taken / %d skipped / %d failed'
+                     % (auto.get('taken', 0), auto.get('skipped', 0),
+                        auto.get('failed', 0)))
     for ex in report['exclusions']:
         lines.append('  excluded %s at epoch %d'
                      % (ex.get('worker'), ex.get('epoch', -1)))

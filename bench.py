@@ -418,6 +418,22 @@ def resolve_devices():
     return jax.devices(), True
 
 
+def probed_devices():
+    """The device list, routed through the subprocess probe — the ONLY
+    way bench code may query devices.
+
+    BENCH_r05's lesson, finished: ``ensure_platform()`` (idempotent —
+    an explicit ``JAX_PLATFORMS`` short-circuits it, so post-``main()``
+    calls are free) decides the platform in a SUBPROCESS before this
+    process's backend can hang or die on driver acquisition, and
+    ``resolve_devices()`` absorbs an UNAVAILABLE raise that slips
+    through anyway. Every former in-process ``jax.devices()`` call in
+    this file rides this, so a flaky TPU backend can never zero out a
+    round's perf record from a helper that forgot the fallback."""
+    ensure_platform()
+    return resolve_devices()[0]
+
+
 def bench_grad_sync(steps=10):
     """Bucketed gradient-sync microbench (the bucketing scheduler's
     observable): an AllReduce(chunk_size=2) strategy over 16 synthetic
@@ -443,7 +459,7 @@ def bench_grad_sync(steps=10):
                                                grad_bucket_layout)
 
     n_vars, dim = 16, 128
-    devs = jax.devices()
+    devs = probed_devices()
 
     def init_fn(rng):
         return {'v%02d' % i: jnp.zeros((dim, dim), jnp.float32)
@@ -524,7 +540,7 @@ def _bench_simulator_inner(steps):
         return LSTMLM(vocab=2000, dim=64, hidden=128, n_layers=1)
 
     model = model_fn()
-    n = max(1, len(jax.devices()))
+    n = max(1, len(probed_devices()))
     rs = ResourceSpec(resource_info={'nodes': [{
         'address': 'localhost', 'chief': True, 'cpus': [0],
         'gpus': list(range(n)), 'network_bandwidth': 100}]})
@@ -1005,6 +1021,185 @@ def _bench_recovery_inner(steps, kill_at):
     }
 
 
+def bench_elastic(steps=8, join_at=2):
+    """Elastic scale-UP A/B (ISSUE 6 acceptance).
+
+    Runs the SAME chief workload twice beside a simulated peer worker:
+    once at a fixed 2-worker membership (the ground-truth baseline) and
+    once scaling 2 -> 3 mid-run — a third worker admits itself through
+    the REAL :func:`~autodist_tpu.runtime.session.admit_worker`
+    handshake once the run has passed step ``join_at``, and the chief's
+    live membership (epoch bump -> world refresh -> per-slice gate
+    party count) must pick it up without a restart. Records the admit
+    wall time, steps blocked at the gate during the join, the chief's
+    observed joins / epoch / strategy re-rank decisions, and the final
+    state's max abs diff vs the fixed-membership ground truth (the
+    simulated workers push no deltas, so the expected diff is 0.0).
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_elastic_inner(steps, join_at)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _elastic_run(port, steps, join_at=None, staleness=1, dim=48):
+    """One chief run beside a simulated peer p1; with ``join_at``, a
+    third worker live-JOINs (the real admit handshake) once p1 has
+    published that step, then keeps pace to the end. Returns (per-step
+    walls, final W, health report, admit record or None)."""
+    import threading
+
+    import autodist_tpu as ad
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.runtime.session import admit_worker
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+    from autodist_tpu.utils.profiling import health_report
+
+    with single_process_loose_env(port, depth=1):
+        autodist = ad.AutoDist(
+            resource_info={'nodes': [
+                {'address': 'localhost', 'gpus': [0], 'chief': True,
+                 'network_bandwidth': 100}]},
+            strategy_builder=ad.strategy.PS(staleness=staleness))
+        rng = np.random.RandomState(0)
+        W0 = rng.randn(dim, 3).astype(np.float32)
+        feed = rng.randn(8, dim).astype(np.float32)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None, dim], dtype=np.float32,
+                               name='x')
+            W = ad.Variable(W0, name='W')
+            loss = ad.ops.reduce_mean(
+                ad.ops.square(ad.ops.matmul(x, W)))
+            train_op = ad.optimizers.SGD(0.1).minimize(loss, [W])
+            autodist._build()
+            ns = autodist._transformed[0].id
+            peer_ready = threading.Event()
+            admit_rec = {}
+
+            def peer():
+                c = CoordClient(('127.0.0.1', port))
+                gen = c.incr('fence/%s/p1' % ns, 0)
+                c.fence('fence/%s/p1' % ns, gen)
+                c.heartbeat('%s/p1' % ns)
+                peer_ready.set()
+                c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+                for s in range(1, steps + 1):
+                    c.heartbeat('%s/p1' % ns)
+                    c.publish_step('p1', s, prefix='%s/step/' % ns)
+                    time.sleep(0.05)
+                c.set('done/%s/p1' % ns, '1')
+                c.publish_step('p1', 1 << 30, prefix='%s/step/' % ns)
+                c.close()
+
+            def joiner():
+                c = CoordClient(('127.0.0.1', port))
+                # join once the run is demonstrably past join_at
+                deadline = time.time() + 60.0
+                while time.time() < deadline:
+                    if c.incr('%s/step/p1' % ns, 0) >= join_at:
+                        break
+                    time.sleep(0.02)
+                admit = admit_worker(c, ns)
+                admit_rec.update(admit)
+                me = admit['worker']
+                for s in range(admit['adopted_step'] + 1, steps + 1):
+                    c.heartbeat('%s/%s' % (ns, me))
+                    c.publish_step(me, s, prefix='%s/step/' % ns)
+                    time.sleep(0.05)
+                c.set('done/%s/%s' % (ns, me), '1')
+                c.publish_step(me, 1 << 30, prefix='%s/step/' % ns)
+                c.close()
+
+            threads = [threading.Thread(target=peer, daemon=True)]
+            if join_at is not None:
+                threads.append(threading.Thread(target=joiner,
+                                                daemon=True))
+            for t in threads:
+                t.start()
+            peer_ready.wait(30.0)
+            sess = autodist.create_distributed_session()
+            # compile + warmup OUTSIDE the timed walls: the first
+            # step's multi-second jit would otherwise classify as
+            # "blocked by the join" and skew the A/B means
+            # asymmetrically (both runs pay it identically here)
+            sess.run(train_op, {x: feed})
+            walls = []
+            for _ in range(steps - 1):
+                t0 = time.perf_counter()
+                sess.run(train_op, {x: feed})
+                walls.append(time.perf_counter() - t0)
+            w_final = sess.get_variable_value('W')
+            report = health_report(sess.health_stats)
+            sess.close()
+            for t in threads:
+                t.join(timeout=15.0)
+        return walls, w_final, report, (admit_rec or None)
+
+
+def _bench_elastic_inner(steps, join_at):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    hb_timeout = 1.5
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    saved = {k: os.environ.get(k)
+             for k in ('AUTODIST_PEER_FAILURE_POLICY',
+                       'AUTODIST_HEARTBEAT_TIMEOUT')}
+    os.environ['AUTODIST_PEER_FAILURE_POLICY'] = 'exclude'
+    os.environ['AUTODIST_HEARTBEAT_TIMEOUT'] = str(hb_timeout)
+    try:
+        base_walls, w_fixed, _, _ = _elastic_run(port, steps, None)
+        walls, w_scaled, report, admit = _elastic_run(
+            port, steps, join_at)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+    blocked = [i + 1 for i, w in enumerate(walls) if w > hb_timeout / 2]
+    unblocked = [w for i, w in enumerate(walls) if i + 1 not in blocked]
+    return {
+        'steps': steps,
+        'join_at': join_at,
+        'admit_wall_s': round((admit or {}).get('admit_wall_s', 0.0),
+                              4),
+        'adopted_step': (admit or {}).get('adopted_step'),
+        'steps_blocked': len(blocked),
+        'mean_step_wall_s': round(float(np.mean(unblocked)), 5)
+        if unblocked else 0.0,
+        'baseline_mean_step_wall_s': round(float(np.mean(base_walls)),
+                                           5),
+        # the joined worker pushes no deltas, so scaling mid-run must
+        # leave the chief's math untouched: expected 0.0
+        'state_max_abs_diff': float(np.abs(w_scaled - w_fixed).max()),
+        'joins_observed': report.get('joins', []),
+        'world': report.get('world', 0),
+        'epoch': report.get('epoch', 0),
+        'replans': [
+            {k: r.get(k) for k in ('world', 'kept', 'predicted',
+                                   'predicted_step_time_s', 'error')
+             if r.get(k) is not None}
+            for r in report.get('replans', [])],
+    }
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -1028,8 +1223,9 @@ def bench_scaling(steps=5):
                                                  TransformerLM)
     from autodist_tpu.parallel.axes import ParallelSpec
 
-    n = max(1, len(jax.devices()))
-    on_tpu = jax.devices()[0].platform == 'tpu'
+    devs = probed_devices()
+    n = max(1, len(devs))
+    on_tpu = devs[0].platform == 'tpu'
     if on_tpu:
         cfg = TransformerConfig.gpt_small(dtype=jnp.bfloat16, remat=True)
         per_dev_batch, seq = 64, 512
@@ -1090,7 +1286,7 @@ def bench_scaling(steps=5):
         'vs_baseline': 0.0,
         'extra': {
             'devices': n,
-            'platform': jax.devices()[0].platform,
+            'platform': devs[0].platform,
             'tokens_per_sec_per_chip_dp1': round(tps1, 1),
             'parallel_efficiency': round(tpsn / tps1, 3) if n > 1 else 1.0,
             'serialized_weak_scaling_efficiency':
@@ -1129,6 +1325,7 @@ def main():
         result['extra']['ps_pipeline'] = bench_ps_pipeline()
         result['extra']['recovery'] = bench_recovery()
         result['extra']['sparse_ps'] = bench_sparse_ps()
+        result['extra']['elastic'] = bench_elastic()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -1145,6 +1342,7 @@ def main():
     ps_pipeline = bench_ps_pipeline()
     recovery = bench_recovery()
     sparse_ps = bench_sparse_ps()
+    elastic = bench_elastic()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -1163,6 +1361,7 @@ def main():
                 'ps_pipeline': ps_pipeline,
                 'recovery': recovery,
                 'sparse_ps': sparse_ps,
+                'elastic': elastic,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -1216,7 +1415,8 @@ def main():
                       'simulator': simulator,
                       'ps_pipeline': ps_pipeline,
                       'recovery': recovery,
-                      'sparse_ps': sparse_ps},
+                      'sparse_ps': sparse_ps,
+                      'elastic': elastic},
         }
     print(json.dumps(result))
 

@@ -204,6 +204,194 @@ def test_exclude_kill_1_of_4_survivors_finish(tmp_path):
 
 
 @pytest.mark.slow
+def test_elastic_scale_up_2_4_3(tmp_path):
+    """ISSUE 6 acceptance: a running 2-worker namespace scales 2 -> 4
+    -> 3 with REAL processes — two live JOINs through the admit
+    handshake (AUTODIST_ELASTIC_JOIN sessions adopting the published
+    step floor and the PS params), then the second joiner is
+    hard-killed (os._exit via its seeded faultline plan) and the PR 4
+    exclude path fences + shrinks membership. Survivors finish every
+    step and the final training state matches the fixed-membership
+    ground truth within the loose-mode accumulation bound (the model's
+    gradients are data-constant, so the expected state is a closed form
+    over the exact per-worker push counts)."""
+    body = textwrap.dedent("""
+        RESOURCE_INFO = {'nodes': [
+            {'address': 'localhost', 'gpus': [0], 'chief': True,
+             'network_bandwidth': 100},
+            {'address': '127.0.0.1', 'gpus': [0],
+             'network_bandwidth': 100}]}
+        TOTAL_STEPS = 12
+        autodist = ad.AutoDist(
+            resource_info=RESOURCE_INFO,
+            strategy_builder=ad.strategy.PS(staleness=2))
+        pid = int(os.environ['AUTODIST_PROCESS_ID'])
+        join_order = int(os.environ.get('TEST_JOIN_ORDER', '0'))
+        inputs, _ = make_data(123)           # same data on every worker
+        with autodist.scope():
+            x = ad.placeholder(shape=[None], dtype=np.float32, name='x')
+            W = ad.Variable(5.0, name='W')
+            b = ad.Variable(0.0, name='b')
+            # LINEAR loss: dW = mean(x), db = 1 — data-constant
+            # gradients make the final state a closed form over the
+            # total number of landed pushes, whatever the interleaving
+            loss = ad.ops.reduce_mean(W * x + b)
+            train_op = ad.optimizers.SGD(0.01).minimize(loss, [W, b])
+            if join_order == 2:
+                # the SECOND joiner waits for the first join so the
+                # ordinals (and the victim identity, p3) are stable
+                autodist._build()
+                ns = autodist._transformed[0].id
+                deadline = time.time() + 240.0
+                while time.time() < deadline:
+                    if autodist._coord.incr(ns + '/join/world', 0) >= 3:
+                        break
+                    time.sleep(0.2)
+                else:
+                    raise RuntimeError('first join never happened')
+            sess = autodist.create_distributed_session()
+            ns = sess._ns
+            me = sess._worker_name
+            start = sess.step_count
+            print('ADMIT ' + json.dumps(
+                {'worker': me, 'start': start}), flush=True)
+            if join_order == 2:
+                # the victim: dies publishing its SECOND post-join step
+                # (that step's push has landed, its publish has not)
+                from autodist_tpu.utils.faultline import (FaultLine,
+                                                          FaultPlan)
+                FaultLine(FaultPlan([
+                    {'kind': 'kill_worker', 'worker': me, 'step': 2,
+                     'mode': 'exit'}]), worker=me).install()
+            for s in range(start, TOTAL_STEPS):
+                sess.run(train_op, {x: inputs})
+                done = s + 1
+                # pace the launch cohort so the joins land mid-run:
+                # world >= 3 by step 4, >= 4 by step 6
+                if join_order == 0 and done in (4, 6):
+                    want = 3 if done == 4 else 4
+                    deadline = time.time() + 240.0
+                    while time.time() < deadline:
+                        if sess._coord.incr(ns + '/join/world',
+                                            0) >= want:
+                            break
+                        time.sleep(0.2)
+                    else:
+                        raise RuntimeError('join %d never happened'
+                                           % want)
+            autodist._coord.barrier('test/trained', 3, timeout_s=240.0)
+            b_final = float(np.ravel(sess.get_variable_value('b'))[0])
+            w_final = float(np.ravel(sess.get_variable_value('W'))[0])
+            health = sess.health_stats
+        print('RESULT ' + json.dumps(
+            {'pid': pid, 'worker': me, 'start': start, 'b': b_final,
+             'w': w_final, 'steps': TOTAL_STEPS,
+             'world': health['world'],
+             'active': health['active_workers'],
+             'excluded': health['excluded'],
+             'epoch': health['epoch'],
+             'joins': health['joins'],
+             'replans': len(health['replans'])}), flush=True)
+        autodist._coord.barrier('test/done', 3, timeout_s=240.0)
+    """)
+    script = tmp_path / 'prog.py'
+    script.write_text(COMMON_PRELUDE % {'repo': REPO} + body)
+    coord_service = '127.0.0.1:%d' % free_port()
+    jax_coord = '127.0.0.1:%d' % free_port()
+    run_id = 'chaos-elastic-1'
+
+    def env_for(pid, join_order=0):
+        env = dict(os.environ)
+        env.pop('AUTODIST_IS_TESTING', None)
+        env.update({
+            'AUTODIST_PROCESS_ID': str(pid),
+            'AUTODIST_NUM_PROCESSES': '2',
+            'AUTODIST_COORDINATOR_ADDR': jax_coord,
+            'AUTODIST_COORD_SERVICE_ADDR': coord_service,
+            'AUTODIST_RUN_ID': run_id,
+            'AUTODIST_PEER_FAILURE_POLICY': 'exclude',
+            'AUTODIST_HEARTBEAT_TIMEOUT': '3',
+            'TEST_JOIN_ORDER': str(join_order),
+        })
+        if pid > 0:
+            env['AUTODIST_WORKER'] = '127.0.0.1'
+        if join_order:
+            env['AUTODIST_ELASTIC_JOIN'] = '1'
+        return env
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(script)], env=env_for(pid),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)]
+    # joiners: advisory pids; their admit claim issues the real slots
+    joiners = [subprocess.Popen(
+        [sys.executable, str(script)], env=env_for(pid, join_order=jo),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for jo, pid in ((1, 2), (2, 3))]
+    outs = []
+    try:
+        for p in procs + joiners:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for q in procs + joiners:
+            q.kill()
+        raise
+    finally:
+        _shutdown_service(coord_service)
+
+    def parse(tag, out):
+        lines = [ln for ln in out.splitlines() if ln.startswith(tag)]
+        return json.loads(lines[-1][len(tag):]) if lines else None
+
+    # cohort + first joiner finish rc=0; the victim was hard-killed
+    for rc, out, err in outs[:3]:
+        assert rc == 0, 'rc=%s\nstdout:%s\nstderr:%s' % (rc, out,
+                                                         err[-4000:])
+    assert outs[3][0] != 0, 'the victim was never killed'
+    victim_admit = parse('ADMIT ', outs[3][1])
+    assert victim_admit and victim_admit['worker'] == 'p3', victim_admit
+    assert parse('RESULT ', outs[3][1]) is None   # died mid-run
+
+    results = {}
+    for rc, out, err in outs[:3]:
+        r = parse('RESULT ', out)
+        assert r, 'no RESULT:\n%s\n%s' % (out, err[-2000:])
+        results[r['worker']] = r
+    assert sorted(results) == ['p0', 'p1', 'p2']
+    # 2 -> 4 -> 3: every survivor converged on world 4 with p3 excluded
+    for r in results.values():
+        assert r['world'] == 4, r
+        assert r['excluded'] == ['p3'], r
+        assert r['active'] == 3, r
+        assert r['steps'] == 12
+    # the chief observed both joins and re-ranked strategies per
+    # observed world GROWTH (two joins landing within one gate slice
+    # batch into a single 2->4 refresh, hence one replan)
+    chief = results['p0']
+    assert sorted(j['worker'] for j in chief['joins']) == ['p2', 'p3']
+    assert 1 <= chief['replans'] <= 2, chief
+    # ground truth over the EXACT per-worker push counts: p0 and p1
+    # push every step, p2 pushes from its adopted floor, the victim
+    # pushed exactly 2 (killed publishing its second step). db = 1
+    # exactly, so b moves -lr per push; the loose-mode accumulation
+    # bound is float32 rounding only.
+    total_pushes = (12 - results['p0']['start']) + \
+        (12 - results['p1']['start']) + \
+        (12 - results['p2']['start']) + 2
+    expected_b = -0.01 * total_pushes
+    for r in results.values():
+        assert abs(r['b'] - expected_b) < 2e-3, (r, expected_b)
+    # dW = mean(x): same closed form, same push count (recompute the
+    # script's make_data(123) draw deterministically)
+    np.random.seed(123)
+    mean_x = float(np.mean(np.random.randn(1000).astype(np.float32)))
+    expected_w = 5.0 - 0.01 * mean_x * total_pushes
+    for r in results.values():
+        assert abs(r['w'] - expected_w) < 2e-2, (r, expected_w)
+
+
+@pytest.mark.slow
 def test_restart_supervised_worker_process_rejoins(tmp_path):
     """ISSUE 4 acceptance (slow): a REAL worker process hard-killed by
     its faultline plan (os._exit mid-publish) is respawned by the real

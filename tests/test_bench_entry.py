@@ -48,6 +48,17 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert c['predicted_step_time_s'] > 0
         assert c['measured_step_time_s'] > 0
     assert any(c['name'].endswith('[auto]') for c in measured)
+    # ISSUE 6: every record carries the elastic scale-up A/B — the live
+    # JOIN really happened (admit wall time measured, membership grew)
+    # and scaling mid-run left the math untouched
+    el = extra['elastic']
+    import shutil
+    if shutil.which('g++'):   # no g++ = no coord service = degraded
+        assert 'error' not in el, el
+        assert el['world'] == 3 and el['joins_observed']
+        assert el['admit_wall_s'] > 0
+        assert el['state_max_abs_diff'] == 0.0
+        assert el['replans']
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

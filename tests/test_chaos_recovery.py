@@ -358,6 +358,330 @@ def test_restart_policy_reborn_worker_rejoins(service, monkeypatch):
         h.close()
 
 
+def test_live_join_grows_membership_mid_run(service, monkeypatch):
+    """ISSUE 6 tentpole (tier-1 form): a third worker live-JOINs a
+    running 2-worker namespace through the real admit handshake; the
+    chief's per-slice gate membership picks the grown world up WITHOUT
+    a restart, training finishes on the ground-truth trajectory, and
+    the chief records the observed join, the epoch bump and the
+    simulator's predicted-vs-kept re-rank decision."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.runtime.session import admit_worker
+    from autodist_tpu.utils.profiling import health_report
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '2.0')
+    steps = 6
+    h = _ChiefHarness(service)
+    try:
+        stop = threading.Event()
+        t_peer = threading.Thread(
+            target=_peer_loop, args=(service, h.ns, 'p1', steps),
+            kwargs={'interval': 0.05}, daemon=True)
+        admitted = threading.Event()
+        admit_rec = {}
+
+        def joiner():
+            c = CoordClient(('127.0.0.1', service))
+            admit_rec.update(admit_worker(c, h.ns))
+            admitted.set()
+            me = admit_rec['worker']
+            last = admit_rec['adopted_step']
+            while not stop.wait(0.05):
+                if last >= steps:
+                    break
+                last += 1
+                c.heartbeat('%s/%s' % (h.ns, me))
+                c.publish_step(me, last, prefix='%s/step/' % h.ns)
+            c.set('done/%s/%s' % (h.ns, me), '1')
+            c.publish_step(me, 1 << 30, prefix='%s/step/' % h.ns)
+            c.close()
+
+        t_peer.start()
+        sess = h.create_session()
+        for _ in range(2):
+            sess.run(h.train_op, {h.x: h.feed})
+        t_join = threading.Thread(target=joiner, daemon=True)
+        t_join.start()
+        assert admitted.wait(30.0), 'joiner never admitted'
+        for _ in range(steps - 2):
+            sess.run(h.train_op, {h.x: h.feed})
+        w_final = sess.get_variable_value('W')
+        rep = health_report(sess.health_stats)
+        stop.set()
+        t_peer.join(timeout=15.0)
+        t_join.join(timeout=15.0)
+        # the admit handshake issued the next ordinal and adopted the
+        # live step floor (>= 1: both members had published)
+        assert admit_rec['worker'] == 'p2'
+        assert admit_rec['world'] == 3
+        assert admit_rec['adopted_step'] >= 1
+        assert admit_rec['admit_wall_s'] > 0.0
+        # the chief adopted the grown membership mid-run
+        assert rep['world'] == 3 and rep['active_workers'] == 3
+        assert rep['joins'] == [{'worker': 'p2', 'epoch': 1}]
+        assert rep['epoch'] >= 1 and rep['epoch_bumps'] >= 1
+        # the chief re-ranked strategies for the new world size and
+        # recorded predicted-vs-kept (execution keeps the plan until
+        # live resharding exists)
+        assert len(rep['replans']) == 1
+        replan = rep['replans'][0]
+        assert replan.get('error') is None, replan
+        assert replan['world'] == 3 and replan['migrated'] is False
+        assert replan['predicted']
+        # simulated workers push no deltas: the trajectory is untouched
+        np.testing.assert_allclose(
+            w_final, _ground_truth(h.W0, h.feed, steps),
+            rtol=2e-4, atol=2e-5)
+    finally:
+        h.close()
+
+
+def test_join_killed_mid_admit_ghost_is_excluded(service, monkeypatch):
+    """ISSUE 6 acceptance: a worker killed MID-ADMIT (after the slot
+    claim and epoch bump, before its step adoption) leaves survivors
+    unblocked and membership consistent: the ghost is a VISIBLE member
+    with no step counter and no beat, so it blocks at most one gate
+    window before the never-beat rule declares it dead and the exclude
+    path fences + releases its slot; a second worker joins cleanly and
+    the run finishes on the ground-truth trajectory."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.runtime.session import admit_worker
+    from autodist_tpu.utils.faultline import (FaultLine, FaultPlan,
+                                              InjectedFault)
+    from autodist_tpu.utils.profiling import health_report
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '1.0')
+    steps = 6
+    h = _ChiefHarness(service)
+    try:
+        stop = threading.Event()
+        t_peer = threading.Thread(
+            target=_peer_loop, args=(service, h.ns, 'p1', steps),
+            kwargs={'interval': 0.05}, daemon=True)
+        ghost_died = threading.Event()
+        admitted = threading.Event()
+
+        # fires once, on the FIRST step/p2 frame — the ghost joiner's
+        # step adoption; the chief's later release of the same counter
+        # passes through (the fault is spent)
+        plan = FaultPlan([{'kind': 'join_kill', 'mode': 'raise',
+                           'match': '%s/step/p2' % h.ns}])
+
+        def ghost_joiner():
+            c = CoordClient(('127.0.0.1', service))
+            try:
+                admit_worker(c, h.ns)
+            except InjectedFault:
+                ghost_died.set()     # claimed p2, published nothing
+            finally:
+                c.close()
+
+        def live_joiner():
+            ghost_died.wait(30.0)
+            c = CoordClient(('127.0.0.1', service))
+            admit = admit_worker(c, h.ns)
+            admitted.set()
+            me = admit['worker']
+            last = admit['adopted_step']
+            while not stop.wait(0.05):
+                if last >= steps:
+                    break
+                last += 1
+                c.heartbeat('%s/%s' % (h.ns, me))
+                c.publish_step(me, last, prefix='%s/step/' % h.ns)
+            c.set('done/%s/%s' % (h.ns, me), '1')
+            c.publish_step(me, 1 << 30, prefix='%s/step/' % h.ns)
+            c.close()
+
+        t_peer.start()
+        with FaultLine(plan) as fl:
+            sess = h.create_session()
+            for _ in range(2):
+                sess.run(h.train_op, {h.x: h.feed})
+            t_ghost = threading.Thread(target=ghost_joiner, daemon=True)
+            t_live = threading.Thread(target=live_joiner, daemon=True)
+            t_ghost.start()
+            t_live.start()
+            assert admitted.wait(30.0), 'live joiner never admitted'
+            for _ in range(steps - 2):
+                sess.run(h.train_op, {h.x: h.feed})
+            w_final = sess.get_variable_value('W')
+            rep = health_report(sess.health_stats, faultline=fl)
+        stop.set()
+        for t in (t_peer, t_ghost, t_live):
+            t.join(timeout=15.0)
+        assert ghost_died.is_set()
+        assert rep['injected_join_faults'] == 1
+        # the live joiner took the NEXT ordinal (the ghost's leaked)
+        assert rep['world'] == 4
+        # the ghost was declared dead by the never-beat rule and
+        # excluded (its exclusion epoch depends on whether the second
+        # join landed first); the live membership is chief + p1 + p3
+        assert [e['worker'] for e in rep['exclusions']] == ['p2']
+        assert rep['active_workers'] == 3
+        assert sorted(j['worker'] for j in rep['joins']) == ['p2', 'p3']
+        # and the math never noticed any of it
+        np.testing.assert_allclose(
+            w_final, _ground_truth(h.W0, h.feed, steps),
+            rtol=2e-4, atol=2e-5)
+    finally:
+        h.close()
+
+
+def test_real_session_live_joins(service, monkeypatch):
+    """A REAL session created with AUTODIST_ELASTIC_JOIN=1 joins a
+    running namespace end-to-end: claims the next slot, rewrites its
+    identity env, skips the init barrier, pulls CURRENT params from the
+    PS instead of re-seeding, adopts the published step floor, and can
+    immediately train a gated step."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setenv('AUTODIST_WORKER', '127.0.0.1')   # non-chief
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    monkeypatch.setenv('AUTODIST_ELASTIC_JOIN', '1')
+    h = _ChiefHarness(service)
+    try:
+        # a live 2-worker cohort: seeded + trained vars, published
+        # steps, completed init rendezvous, seeded world counter
+        c = CoordClient(('127.0.0.1', service))
+        trained = np.full((h.dim, 3), 7.0, np.float32)
+        c.vset('%s/var/W' % h.ns, trained)
+        c.publish_step('p0', 4, prefix='%s/step/' % h.ns)
+        c.publish_step('p1', 5, prefix='%s/step/' % h.ns)
+        c.incr('%s/join/world' % h.ns, 2)
+        c.set('%s/session/init-done' % h.ns, '1')
+        monkeypatch.setenv('AUTODIST_PROCESS_ID', '7')   # advisory only
+        sess = h.create_session()            # must NOT hang on barrier
+        hs = sess.health_stats
+        assert hs['joining'] and not hs['rejoining']
+        # the claim decides identity, not the spawner's env
+        assert sess._worker_name == 'p2'
+        assert hs['world'] == 3 and hs['active_workers'] == 3
+        assert hs['admitted']['admit_wall_s'] > 0.0
+        # adopted the floor of the live members' published steps
+        assert sess.step_count == 4
+        assert c.incr('%s/step/p2' % h.ns, 0) == 4
+        # pulled the trained params, not its init values
+        np.testing.assert_array_equal(
+            np.asarray(sess._local_value('W'), np.float32), trained)
+        # and the epoch bump is observable to survivors
+        assert c.incr('%s/epoch' % h.ns, 0) == 1
+        # a gated train step runs immediately: step 5 needs
+        # min(4, 5, 4) >= 5 - staleness(1) = 4
+        sess.run(h.train_op, {h.x: h.feed})
+        assert sess.step_count == 5
+        c.close()
+    finally:
+        h.close()
+
+
+def test_fresh_cohort_resets_stale_elastic_state(service, monkeypatch):
+    """A reused service holding a crashed previous run's elastic state
+    (inflated join/world counter, stale session/init-done marker) must
+    not leak phantom members into a fresh run: a fresh cohort member
+    never adopts world growth at init (no join can legitimately
+    precede its rendezvous), and the chief deletes the stale marker
+    and forces the counter back to the launch quorum before the
+    barrier."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.runtime.session import Session
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    h = _ChiefHarness(service)
+    try:
+        c = CoordClient(('127.0.0.1', service))
+        c.incr('%s/join/world' % h.ns, 5)      # crashed-run leftovers
+        c.set('%s/session/init-done' % h.ns, 'stale')
+        # a fresh (non-rejoining) member racing ahead of the chief's
+        # reset: its init-time refresh must NOT adopt the stale growth
+        stub = Session.__new__(Session)
+        stub._coord = c
+        stub._ns = h.ns
+        stub._worker_name = 'p1'
+        stub._num_workers = 2
+        stub._world = 2
+        stub._is_chief = False
+        stub._excluded = set()
+        stub._epoch_seen = 0
+        stub._health = {'joins': [], 'replans': []}
+        stub._refresh_membership(adopt_growth=False)
+        assert stub._world == 2 and stub._health['joins'] == []
+        # the real chief then resets counter + marker at session init
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_peer_loop, args=(service, h.ns, 'p1', 1, stop),
+            kwargs={'done_on_finish': False}, daemon=True)
+        t.start()
+        sess = h.create_session()
+        assert c.incr('%s/join/world' % h.ns, 0) == 2
+        assert c.get('%s/session/init-done' % h.ns) == '1'
+        assert sess._world == 2
+        stop.set()
+        t.join(timeout=10.0)
+        c.close()
+    finally:
+        h.close()
+
+
+def test_join_refused_past_max_workers(service, monkeypatch):
+    """AUTODIST_MAX_WORKERS ceilings the admit claim: a join that would
+    grow membership past it is refused before anything is claimed."""
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.runtime.session import admit_worker
+    monkeypatch.setenv('AUTODIST_MAX_WORKERS', '2')
+    c = CoordClient(('127.0.0.1', service))
+    ns = 'nsmax'
+    c.set(ns + '/session/init-done', '1')
+    c.incr(ns + '/join/world', 2)
+    with pytest.raises(RuntimeError, match='AUTODIST_MAX_WORKERS'):
+        admit_worker(c, ns)
+    assert c.incr(ns + '/join/world', 0) == 2   # nothing claimed
+    c.close()
+
+
+def test_raced_over_cap_claim_is_retired_as_excluded(service,
+                                                     monkeypatch):
+    """The cap pre-check and the slot claim are separate RPCs: when a
+    concurrent join races a claim past AUTODIST_MAX_WORKERS, the
+    over-cap claim cannot be rolled back (ordinals are never
+    re-issued) — it is retired as excluded + released, so any survivor
+    that ever sees the slot skips it without a heartbeat window and
+    live membership never exceeds the cap."""
+    from autodist_tpu.runtime.coord_client import (CLEAN_CLOSE_STEP,
+                                                   CoordClient)
+    from autodist_tpu.runtime.session import admit_worker
+    monkeypatch.setenv('AUTODIST_MAX_WORKERS', '3')
+    ns = 'nsrace'
+    real = CoordClient(('127.0.0.1', service))
+    real.set(ns + '/session/init-done', '1')
+    real.incr(ns + '/join/world', 3)        # already AT the cap
+
+    class RacyClient:
+        """Delegating client whose first world read is one claim stale
+        — the exact window between another joiner's claim and ours."""
+
+        def __init__(self):
+            self._stale = True
+
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+        def incr(self, key, delta=1):
+            if delta == 0 and key.endswith('join/world') and \
+                    self._stale:
+                self._stale = False
+                return real.incr(key, 0) - 1
+            return real.incr(key, delta)
+
+    with pytest.raises(RuntimeError, match='raced this claim'):
+        admit_worker(RacyClient(), ns)
+    # the over-cap slot (p3) is pre-retired: excluded marker set and
+    # step counter released at the clean-close sentinel
+    assert real.incr('excluded/%s/p3' % ns, 0) == 1
+    assert real.incr(ns + '/step/p3', 0) == CLEAN_CLOSE_STEP
+    # and it never became observable membership: no epoch bump
+    assert real.incr(ns + '/epoch', 0) == 0
+    real.close()
+
+
 def test_session_rejoins_at_published_step(service, monkeypatch):
     """A REAL session created as a replacement (generation already
     bumped) rejoins: skips the init barrier, adopts the published step,

@@ -264,6 +264,177 @@ def test_coord_service_targets_dedup_local_spellings(monkeypatch):
                                            ('127.0.0.1', 5001)]
 
 
+# -- elastic scale-up path + autoscale hook (ISSUE 6) ------------------------
+
+def _loose_strategy():
+    from autodist_tpu.strategy.base import (PSSynchronizer, Strategy,
+                                            StrategyNode)
+    s = Strategy(strategy_id='scaleup-test')
+    s.node_config = [StrategyNode(var_name='w',
+                                  synchronizer=PSSynchronizer(
+                                      staleness=2))]
+    return s
+
+
+def _coordinator(nodes=2):
+    from autodist_tpu.resource_spec import ResourceSpec
+    info = {'nodes': [{'address': 'localhost', 'chief': True,
+                       'gpus': [0], 'network_bandwidth': 10}]}
+    for i in range(1, nodes):
+        info['nodes'].append({'address': '127.0.0.%d' % i, 'gpus': [0],
+                              'network_bandwidth': 10})
+    co = Coordinator.__new__(Coordinator)
+    co._strategy = _loose_strategy()
+    co._resource_spec = ResourceSpec(resource_info=info)
+    co._cluster = None
+    co._shutting_down = False
+    co.supervisors = []
+    co._token_path = ''
+    co._next_pid = nodes
+    return co
+
+
+def _capture_logs(caplog):
+    from autodist_tpu.utils import logging as adlog
+    logger = adlog.get_logger()
+    logger.addHandler(caplog.handler)
+    return logger
+
+
+def test_scale_up_launches_joiners_with_elastic_env(monkeypatch,
+                                                    caplog):
+    """scale_up ships ADDITIONAL workers with AUTODIST_ELASTIC_JOIN=1
+    and fresh advisory process ids — the env that routes them through
+    the Session admit handshake instead of the launch rendezvous."""
+    monkeypatch.setenv('AUTODIST_DEBUG_REMOTE', '1')
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
+    co = _coordinator(nodes=2)
+    logger = _capture_logs(caplog)
+    try:
+        co.scale_up(2)
+    finally:
+        logger.removeHandler(caplog.handler)
+    launched = [r.getMessage() for r in caplog.records
+                if 'AUTODIST_ELASTIC_JOIN=1' in r.getMessage()]
+    assert len(launched) == 2
+    assert any('AUTODIST_PROCESS_ID=2' in m for m in launched)
+    assert any('AUTODIST_PROCESS_ID=3' in m for m in launched)
+    assert co._next_pid == 4
+
+
+def test_scale_up_restart_policy_maps_to_exclude(monkeypatch, caplog):
+    """A scale-up worker is never supervised under 'restart': the
+    monotone world counter never re-issues its slot, so a rebind-style
+    restart would leave survivors waiting on a counter no replacement
+    advances — a dead joiner's slot is excluded and a replacement
+    re-JOINs fresh."""
+    monkeypatch.setenv('AUTODIST_DEBUG_REMOTE', '1')
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'restart')
+    co = _coordinator(nodes=2)
+    logger = _capture_logs(caplog)
+    try:
+        co.scale_up(1)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert any('exclude semantics' in r.getMessage()
+               for r in caplog.records)
+
+
+def test_scale_up_clamped_by_max_workers(monkeypatch):
+    monkeypatch.setenv('AUTODIST_DEBUG_REMOTE', '1')
+    monkeypatch.setenv('AUTODIST_MAX_WORKERS', '3')
+    co = _coordinator(nodes=2)
+    co.scale_up(5)                  # room for exactly one more
+    assert co._next_pid == 3
+
+
+def test_autoscale_policy_signals():
+    """The built-in policy grows on EITHER signal (step-time target or
+    queue depth) and has no opinion when both are within bounds or
+    absent."""
+    from autodist_tpu.runtime.coordinator import autoscale_policy
+    pol = autoscale_policy(step_time_target_s=0.5, queue_depth_max=10)
+    assert pol({'step_time_s': 1.0}, 2) == 3
+    assert pol({'queue_depth': 20}, 2) == 3
+    assert pol({'step_time_s': 0.1, 'queue_depth': 1}, 2) is None
+    assert pol({}, 2) is None
+    assert autoscale_policy(step_time_target_s=0.5, grow_by=2)(
+        {'step_time_s': 1.0}, 2) == 4
+
+
+def test_autoscale_controller_executes_and_records(monkeypatch):
+    """Every tick records a decision; growth executes through the
+    injected scale_up, capped by AUTODIST_MAX_WORKERS; scale-down is
+    recorded as skipped, never executed; a failing scale_up is recorded
+    and non-fatal."""
+    from autodist_tpu.runtime.coordinator import (AutoscaleController,
+                                                  autoscale_policy)
+    grown = []
+    ctl = AutoscaleController(
+        autoscale_policy(step_time_target_s=0.5), grown.append,
+        current_world=2, max_workers=3)
+    assert ctl.tick({'step_time_s': 1.0})['action'] == 'scale_up'
+    assert grown == [1] and ctl.world == 3
+    rec = ctl.tick({'step_time_s': 1.0})
+    assert rec['action'] == 'skipped'
+    assert rec['reason'] == 'AUTODIST_MAX_WORKERS'
+    assert ctl.tick({'step_time_s': 0.1})['reason'] == 'no_opinion'
+    down = AutoscaleController(lambda m, w: w - 1, grown.append,
+                               current_world=3, max_workers=8)
+    assert down.tick({})['reason'] == 'scale_down_unsupported'
+    assert down.world == 3
+
+    def boom(n):
+        raise OSError('ssh down')
+
+    failing = AutoscaleController(lambda m, w: w + 1, boom,
+                                  current_world=2, max_workers=8)
+    rec = failing.tick({})          # must not raise
+    assert rec['action'] == 'failed' and 'ssh down' in rec['error']
+    assert failing.world == 2       # growth not claimed
+    assert ctl.taken == 1 and ctl.skipped == 2
+
+
+def test_autoscale_controller_believes_launched_not_asked():
+    """Coordinator.scale_up clamps against its issued-pid room and
+    returns the supervisors it actually started; the controller must
+    advance `world` by what LAUNCHED, not what it asked — phantom
+    capacity would satisfy the policy forever while the job stays
+    under-provisioned."""
+    from autodist_tpu.runtime.coordinator import AutoscaleController
+    partial = AutoscaleController(lambda m, w: w + 2,
+                                  lambda n: ['sup'],   # 1 of 2 asked
+                                  current_world=2, max_workers=8)
+    rec = partial.tick({})
+    assert rec['action'] == 'scale_up'
+    assert rec['launched'] == 1 and partial.world == 3
+
+    nothing = AutoscaleController(lambda m, w: w + 1, lambda n: [],
+                                  current_world=2, max_workers=8)
+    rec = nothing.tick({})
+    assert rec['action'] == 'skipped'
+    assert rec['reason'] == 'scale_up_launched_nothing'
+    assert nothing.world == 2
+
+
+def test_autoscale_controller_resyncs_from_live_world():
+    """Each tick resyncs `world` from the live-membership callable:
+    a death freeing headroom at the cap must re-enable scaling — a
+    local-only monotone world would skip 'AUTODIST_MAX_WORKERS'
+    forever after churn."""
+    from autodist_tpu.runtime.coordinator import AutoscaleController
+    live = {'n': 4}
+    ctl = AutoscaleController(lambda m, w: w + 1,
+                              lambda n: [object()] * n,
+                              current_world=4, max_workers=4,
+                              live_world=lambda: live['n'])
+    assert ctl.tick({})['reason'] == 'AUTODIST_MAX_WORKERS'
+    live['n'] = 3                # a joiner died and was excluded
+    rec = ctl.tick({})
+    assert rec['action'] == 'scale_up' and rec['launched'] == 1
+    assert ctl.world == 4
+
+
 # -- ssh/scp shipping satellite ----------------------------------------------
 
 def test_run_remote_retries_transient_failure_once(monkeypatch):

@@ -254,6 +254,75 @@ def test_stalled_writer_is_slow_but_alive(coord, monkeypatch):
                                   val * 2)
 
 
+def test_join_drop_fires_on_admit_claim(coord):
+    """join_drop defaults its match to the admit handshake's world-
+    claim frames ('join/'): the claim INCR raises OSError and nothing
+    lands — a dropped handshake, not a half-admitted ghost."""
+    from autodist_tpu.utils.faultline import FaultLine, FaultPlan
+    c = coord()
+    plan = FaultPlan([{'kind': 'join_drop'}])
+    with FaultLine(plan) as fl:
+        with pytest.raises(OSError, match='join-handshake'):
+            c.incr('jd/join/world', 1)
+    assert fl.events[0]['kind'] == 'join_drop'
+    # the frame never hit the wire: the claim did not land
+    assert coord().incr('jd/join/world', 0) == 0
+
+
+def test_join_delay_delays_the_claim(coord):
+    from autodist_tpu.utils.faultline import FaultLine, FaultPlan
+    c = coord()
+    plan = FaultPlan([{'kind': 'join_delay', 'seconds': 0.4}])
+    with FaultLine(plan) as fl:
+        t0 = time.monotonic()
+        assert c.incr('jl/join/world', 1) == 1
+        dt = time.monotonic() - t0
+    assert dt >= 0.4
+    assert fl.events[0]['kind'] == 'join_delay'
+
+
+def test_join_kill_mid_admit_windows_are_benign(coord, monkeypatch):
+    """join_kill(mode=raise) against the REAL admit handshake, in both
+    death windows. Before the epoch bump (killed at the slot claim):
+    an INVISIBLE leaked ordinal with no step counter — nothing of it
+    can reach any gate. After the bump (killed at the step publish): a
+    VISIBLE member with no step/beat, exactly the shape the never-beat
+    exclusion rule cleans up (full-stack in test_chaos_recovery). The
+    ordering guarantees there is no third shape — an invisible frozen
+    step counter would stall gates with no recovery path."""
+    from autodist_tpu.runtime.session import admit_worker
+    from autodist_tpu.utils.faultline import (FaultLine, FaultPlan,
+                                              InjectedFault)
+    c = coord()
+    ns = 'jk'
+    c.set(ns + '/session/init-done', '1')
+    c.incr(ns + '/join/world', 2)
+    c.publish_step('p0', 4, prefix=ns + '/step/')
+    c.publish_step('p1', 4, prefix=ns + '/step/')
+    # window 1: killed AT the claim (2nd join/ frame = the +1 INCR):
+    # the claim never lands, nothing observable anywhere
+    plan = FaultPlan([{'kind': 'join_kill', 'mode': 'raise', 'at': 2}])
+    with FaultLine(plan, worker='px') as fl:
+        with pytest.raises(InjectedFault, match='mid-admit'):
+            admit_worker(coord(), ns)
+    assert fl.events[0]['kind'] == 'join_kill'
+    assert c.incr(ns + '/join/world', 0) == 2
+    assert c.incr(ns + '/epoch', 0) == 0
+    # window 2: killed at the step-adoption publish — AFTER the epoch
+    # bump: the claim landed and the member is visible, with no step
+    # counter and no beat (the excludable never-beat shape)
+    plan = FaultPlan([{'kind': 'join_kill', 'mode': 'raise',
+                       'match': ns + '/step/p2'}])
+    with FaultLine(plan) as fl:
+        with pytest.raises(InjectedFault, match='mid-admit'):
+            admit_worker(coord(), ns)
+    assert fl.events[0]['kind'] == 'join_kill'
+    assert c.incr(ns + '/join/world', 0) == 3
+    assert c.incr(ns + '/epoch', 0) == 1
+    assert c.incr(ns + '/step/p2', 0) == 0
+    assert c.incr('hb/%s/p2' % ns, 0) == 0
+
+
 def test_single_faultline_per_process():
     from autodist_tpu.utils.faultline import FaultLine, FaultPlan
     with FaultLine(FaultPlan()):

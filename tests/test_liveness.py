@@ -150,6 +150,87 @@ def test_gate_membership_is_reevaluated_per_slice(coord):
     assert calls['n'] >= 2
 
 
+def test_gate_party_count_reevaluates_upward_mid_run(coord):
+    """ISSUE 6: the gate re-reads its CALLABLE membership every slice
+    in BOTH directions — a slice that starts with 2 parties completes
+    with 3. A worker admitted mid-wait (its step key published before
+    the party count grew, per the admit-handshake ordering) becomes a
+    party the gate genuinely waits for: after the growth the gate must
+    NOT release until the third party reaches the bound."""
+    c = coord()
+    parties = {'n': 2}
+    c.publish_step('p0', 5, prefix='gate4/step/')
+    c.publish_step('p1', 1, prefix='gate4/step/')   # laggard
+
+    calls = {'n': 0}
+
+    def grow_then_release():
+        calls['n'] += 1
+        if calls['n'] == 2:
+            # the joiner: publishes its adopted floor FIRST, then
+            # membership grows (admit_worker's ordering); the laggard
+            # then catches up, so only the NEW party still binds
+            c.publish_step('p2', 1, prefix='gate4/step/')
+            parties['n'] = 3
+            c.publish_step('p1', 5, prefix='gate4/step/')
+        if calls['n'] == 4:
+            c.publish_step('p2', 5, prefix='gate4/step/')
+
+    t0 = time.monotonic()
+    c.staleness_gate(5, 1, lambda: parties['n'], timeout_s=30.0,
+                     prefix='gate4/step/',
+                     failure_check=grow_then_release, slice_s=0.2)
+    assert time.monotonic() - t0 < 10.0
+    # the gate kept waiting after the growth: it only released once
+    # the THIRD party published past the bound (call 4), proving the
+    # upward re-evaluation actually bound it
+    assert calls['n'] >= 4
+
+
+def test_session_membership_grows_on_epoch_bump(coord, monkeypatch):
+    """_check_peers_alive adopts a live JOIN: the epoch bump published
+    by an admitted worker (runtime.session.admit_worker) grows the
+    session's world, its gate party count and its heartbeat peer list
+    — even with heartbeats DISABLED, because membership growth is not
+    failure detection."""
+    from autodist_tpu.runtime.session import Session, admit_worker
+    c = coord()
+    ns = 'nsg'
+    c.set(ns + '/session/init-done', '1')
+    c.incr(ns + '/join/world', 2)
+    c.publish_step('p0', 3, prefix=ns + '/step/')
+    c.publish_step('p1', 3, prefix=ns + '/step/')
+
+    sess = Session.__new__(Session)
+    sess._coord = c
+    sess._ns = ns
+    sess._worker_name = 'p0'
+    sess._num_workers = 2
+    sess._world = 2
+    sess._hb_peers = [ns + '/p1']
+    sess._hb_seen = {}
+    sess._excluded = set()
+    sess._dead_since = {}
+    sess._epoch_seen = 0
+    sess._policy = 'fail'
+    sess._min_workers = 1
+    sess._is_chief = False
+    sess._health = {'missed_beats': 0, 'epoch_bumps': 0,
+                    'exclusions': [], 'rejoins': [],
+                    'recovery_wall_s': [], 'joins': [], 'replans': []}
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    monkeypatch.setenv('AUTODIST_PROCESS_ID', '0')
+
+    assert sess._active_workers() == 2
+    admit = admit_worker(coord(), ns)
+    assert admit['worker'] == 'p2' and admit['epoch'] == 1
+    sess._check_peers_alive()
+    assert sess._world == 3 and sess._active_workers() == 3
+    assert ns + '/p2' in sess._hb_peers
+    assert sess._health['joins'] == [{'worker': 'p2', 'epoch': 1}]
+    assert sess._live_members() == [0, 1, 2]
+
+
 def test_gate_rearms_while_restart_pending(coord):
     """A truthy failure_check (policy=restart: recovery in flight)
     re-arms the gate window: a respawn + recompile longer than one
