@@ -146,8 +146,11 @@ class ENV(Enum):
     # multi-server placement the reference gets from one tf.Server per
     # node (utils/server_starter.py:48-75).
     AUTODIST_PS_ENDPOINTS = (lambda v: v if v else '',)
-    # wire dtype for PS tensor frames: f32 (default) or bf16 (half the
-    # bytes; values are rounded to bf16 on the wire, kept f32 at rest).
+    # wire dtype for PS tensor frames: f32 (default), bf16 (half the
+    # bytes; values rounded to bf16 on the wire, kept f32 at rest) or
+    # i8 (block-quantized ~quarter bytes, PUSH direction only — pulls
+    # and stores ride f32, and the session carries an error-feedback
+    # residual per pushed delta; docs/design/quantized-wire.md).
     AUTODIST_PS_WIRE_DTYPE = (lambda v: v if v else 'f32',)
     # PS frame chunking: tensors above this many wire bytes move as
     # ranged chunks (all B* updates are elementwise, so chunked
@@ -272,6 +275,17 @@ class ENV(Enum):
     # when the process explicitly installs a FaultLine (chaos tests,
     # bench recovery A/B) — production sessions never read it.
     AUTODIST_FAULT_PLAN = (lambda v: v if v else '',)
+    # Block size (elements) for block-quantized int8 wire formats: the
+    # Int8RingCompressor's bucket/ring quantization and the PS data
+    # plane's 'i8' wire dtype both carry ONE f32 scale per block of
+    # this many int8 values (EQuARX-style; per-block scales bound an
+    # outlier's damage to its own block instead of the whole bucket).
+    # Forwarded to launched workers (coordinator _FORWARDED_FLAGS):
+    # every traced host must agree on the block layout — divergent HLO
+    # across SPMD hosts deadlocks, and a PS frame encoded with one
+    # block size decodes with the size carried in its own header.
+    AUTODIST_QUANT_BLOCK = \
+        (lambda v: _min_int('AUTODIST_QUANT_BLOCK', v, 256, lo=8),)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

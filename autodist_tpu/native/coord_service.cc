@@ -16,8 +16,9 @@
 // plane: the reference aggregates cross-worker gradients in
 // ConditionalAccumulators living on the PS task and rides TF's grpc
 // data plane for the bytes (ps_synchronizer.py:556-633); here workers
-// push deltas/gradients as length-prefixed raw frames (f32 or bf16 on
-// the wire, f32 at rest) applied with an atomic elementwise add —
+// push deltas/gradients as length-prefixed raw frames (f32, bf16 or
+// block-quantized i8 on the wire, f32 at rest) applied with an atomic
+// elementwise add —
 // commutative apply-per-push, which is exactly the reference's
 // staleness>0 accumulator mode (take_grad(1): every push is applied).
 // Each tensor has its OWN mutex, so a multi-MB push on one variable
@@ -98,7 +99,12 @@
 //       (wait until >=k keys share <prefix> and their min value >= n)
 //   BARRIER <name> <k> <ms>      -> OK | TIMEOUT   (k-party barrier)
 //   BSET <key> <nbytes> <wire> [<off> <total>]  [payload] -> OK
-//       (store tensor; wire dtype f32|bf16, stored as f32)
+//       (store tensor; wire dtype f32|bf16|i8, stored as f32. The i8
+//        wire is the blockscale format: `u32 block, u32 n, f32 scales
+//        x ceil(n/block), int8 q x n` — one f32 scale per `block`
+//        int8 values, value[i] = q[i] * scale[i/block]. The block
+//        size rides in the frame itself, so any client block size
+//        (AUTODIST_QUANT_BLOCK) decodes)
 //   BGET <key> <wire> [<off> <count>] [v] -> VAL <nbytes> [<ver>]\n
 //       [payload] | NONE   ("v" opts into <ver> = version*2 +
 //        write_in_progress; odd or chunk-to-chunk-changing ver = torn
@@ -109,7 +115,12 @@
 //   BSADD <key> <nrows> <row_bytes> <wire> [<off> <total>]  [payload]
 //       -> VAL <n>   (row-sparse scatter-add: payload is <nrows> int32
 //        row indices then <nrows> rows of wire data; <off>/<total>
-//        count ROWS of the logical push; tensor must already exist)
+//        count ROWS of the logical push; tensor must already exist.
+//        For the i8 wire, <row_bytes> is the TOTAL byte length of the
+//        encoded rows blob — blockscale frames carry a scales header,
+//        so their size is not per-row divisible — and cols is derived
+//        from decoded elements / nrows; f32/bf16 keep the per-row
+//        meaning)
 //   BGETROWS <key> <nrows> <ncols> <wire> [v]  [payload] -> VAL
 //       <nbytes> [<ver>]\n[payload]  | NONE   (fetch just the rows
 //        listed in the int32 request payload; "v" = version field,
@@ -128,6 +139,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -530,8 +542,22 @@ float bf16_to_f32(uint16_t h) {
   return f;
 }
 
+// Block size for i8 frames this service ENCODES (BGET replies); frames
+// it decodes carry their own block size in the header. Read once: the
+// env is fixed for the process lifetime, like the auth token.
+size_t i8_encode_block() {
+  static const size_t block = [] {
+    const char* raw = getenv("AUTODIST_QUANT_BLOCK");
+    long v = raw ? atol(raw) : 0;
+    return v >= 8 ? static_cast<size_t>(v) : static_cast<size_t>(256);
+  }();
+  return block;
+}
+
 // wire "f32": payload is raw little-endian float32; "bf16": raw uint16
-// upper halves of float32. Returns false on a malformed payload.
+// upper halves of float32; "i8": blockscale frame `u32 block, u32 n,
+// f32 scales x ceil(n/block), int8 q x n` (value = q * per-block
+// scale). Returns false on a malformed payload.
 bool decode_wire(std::string_view payload, const std::string& wire,
                  std::vector<float>* out) {
   if (wire == "f32") {
@@ -549,6 +575,31 @@ bool decode_wire(std::string_view payload, const std::string& wire,
     for (size_t i = 0; i < n; ++i) (*out)[i] = bf16_to_f32(src[i]);
     return true;
   }
+  if (wire == "i8") {
+    if (payload.size() < 8) return false;
+    uint32_t block = 0, n = 0;
+    memcpy(&block, payload.data(), 4);
+    memcpy(&n, payload.data() + 4, 4);
+    if (block == 0) return false;
+    const size_t nb = (static_cast<size_t>(n) + block - 1) / block;
+    if (payload.size() != 8 + nb * 4 + n) return false;
+    std::vector<float> scales(nb);
+    if (nb) memcpy(scales.data(), payload.data() + 8, nb * 4);
+    const int8_t* q =
+        reinterpret_cast<const int8_t*>(payload.data() + 8 + nb * 4);
+    out->resize(n);
+    // block-strided inner loop (contiguous, constant scale) so the
+    // dequant auto-vectorizes like the bf16 path — same contention
+    // lesson (BASELINE.md round-4 bf16 row)
+    for (size_t b = 0; b < nb; ++b) {
+      const float s = scales[b];
+      const size_t lo = b * block;
+      const size_t hi = std::min(lo + block, static_cast<size_t>(n));
+      for (size_t i = lo; i < hi; ++i)
+        (*out)[i] = static_cast<float>(q[i]) * s;
+    }
+    return true;
+  }
   return false;
 }
 
@@ -562,6 +613,37 @@ bool encode_wire(const float* v, size_t n, const std::string& wire,
     out->resize(n * 2);
     uint16_t* dst = reinterpret_cast<uint16_t*>(&(*out)[0]);
     for (size_t i = 0; i < n; ++i) dst[i] = f32_to_bf16(v[i]);
+    return true;
+  }
+  if (wire == "i8") {
+    const size_t block = i8_encode_block();
+    const size_t nb = (n + block - 1) / block;
+    out->resize(8 + nb * 4 + n);
+    char* raw = &(*out)[0];
+    const uint32_t block32 = static_cast<uint32_t>(block);
+    const uint32_t n32 = static_cast<uint32_t>(n);
+    memcpy(raw, &block32, 4);
+    memcpy(raw + 4, &n32, 4);
+    float* scales = reinterpret_cast<float*>(raw + 8);
+    int8_t* q = reinterpret_cast<int8_t*>(raw + 8 + nb * 4);
+    for (size_t b = 0; b < nb; ++b) {
+      const size_t lo = b * block;
+      const size_t hi = std::min(lo + block, n);
+      float maxabs = 0.f;
+      for (size_t i = lo; i < hi; ++i)
+        maxabs = std::max(maxabs, std::fabs(v[i]));
+      // the +1e-30f epsilon matches the Python encoder exactly (an
+      // all-zero block must not divide by zero); round-to-nearest +
+      // clamp as branch-free min/max selects so the loop vectorizes
+      const float scale = maxabs / 127.0f + 1e-30f;
+      const float inv = 1.0f / scale;
+      scales[b] = scale;
+      for (size_t i = lo; i < hi; ++i) {
+        float r = std::nearbyintf(v[i] * inv);
+        r = std::max(-127.0f, std::min(127.0f, r));
+        q[i] = static_cast<int8_t>(r);
+      }
+    }
     return true;
   }
   return false;
@@ -608,12 +690,21 @@ size_t payload_size(const std::string& line) {
   if (cmd == "BSADD") {
     // <nrows> int32 indices + <nrows> rows of <row_bytes> wire bytes;
     // guard the product against uint64 wraparound before comparing to
-    // the cap (a wrapped declaration must not buffer toward 2^64)
+    // the cap (a wrapped declaration must not buffer toward 2^64).
+    // i8 frames declare row_bytes as the TOTAL rows-blob length (the
+    // blockscale scales header makes the blob non-row-divisible), so
+    // the payload is indices + exactly that many bytes.
     uint64_t nrows = 0, row_bytes = 0;
-    in >> key >> nrows >> row_bytes;
-    if (in.fail() || row_bytes > kMaxPayload ||
-        nrows > kMaxPayload / (4 + row_bytes))
-      return kBadPayload;
+    std::string wire;
+    in >> key >> nrows >> row_bytes >> wire;
+    if (in.fail() || row_bytes > kMaxPayload) return kBadPayload;
+    if (wire == "i8") {
+      if (nrows > kMaxPayload / 4 ||
+          nrows * 4 > kMaxPayload - row_bytes)
+        return kBadPayload;
+      return static_cast<size_t>(nrows * 4 + row_bytes);
+    }
+    if (nrows > kMaxPayload / (4 + row_bytes)) return kBadPayload;
     uint64_t total = nrows * (4 + row_bytes);
     if (total > kMaxPayload) return kBadPayload;
     return static_cast<size_t>(total);
@@ -901,18 +992,28 @@ std::string handle(const std::string& line, std::string_view payload,
     in >> k >> nrows >> row_bytes >> wire;
     const int64_t off_decl = declared_offset(&in);
     if (is_fenced(*conn)) return abort_open_seq(conn, k, off_decl, kFencedErr);
+    // i8 (blockscale) blobs are not per-row divisible: row_bytes is
+    // the whole blob length and cols derives from decoded elements
+    const bool i8 = wire == "i8";
     const size_t itemsize = wire == "bf16" ? 2 : 4;
-    if (row_bytes == 0 || row_bytes % itemsize)
+    if (row_bytes == 0 || (!i8 && row_bytes % itemsize))
       return abort_open_seq(conn, k, off_decl, "ERR bad row bytes");
-    const size_t ncols = static_cast<size_t>(row_bytes) / itemsize;
     if (payload.size() < nrows * 4)
       return abort_open_seq(conn, k, off_decl, "ERR bad payload");
     std::vector<int32_t> idx(nrows);
     if (nrows) memcpy(idx.data(), payload.data(), nrows * 4);
     std::vector<float> rows;
-    if (!decode_wire(payload.substr(nrows * 4), wire, &rows) ||
-        rows.size() != nrows * ncols)
+    if (!decode_wire(payload.substr(nrows * 4), wire, &rows))
       return abort_open_seq(conn, k, off_decl, "ERR bad payload");
+    // i8 derives ncols from the decoded blob: an empty blob (n=0) with
+    // nrows>0 would make ncols 0 and the shape-check modulo below a
+    // division by zero (SIGFPE kills the whole service) — reject it
+    // like BGETROWS rejects ncols==0
+    if (i8 ? (nrows == 0 || rows.empty() || rows.size() % nrows)
+           : rows.size() != nrows * (row_bytes / itemsize))
+      return abort_open_seq(conn, k, off_decl, "ERR bad payload");
+    const size_t ncols =
+        i8 ? rows.size() / nrows : static_cast<size_t>(row_bytes) / itemsize;
     size_t off, total;
     if (!read_range(&in, static_cast<size_t>(nrows), &off, &total))
       return abort_open_seq(conn, k, off_decl, "ERR bad range");

@@ -6,8 +6,9 @@ Parity with reference ``autodist/kernel/synchronization/compressor.py``:
 commented out in the reference (:208-284); here it is implemented for real
 as a low-rank compressor (round-robin power iteration), and
 ``Int8RingCompressor`` adds a quantized-collective tier the reference
-never had (int8 wire, EQuARX-style), since low-precision + low-rank
-collectives are where TPU ICI bandwidth wins come from.
+never had (int8 wire with per-block f32 scales, EQuARX-style —
+``AUTODIST_QUANT_BLOCK`` elements per scale), since low-precision +
+low-rank collectives are where TPU ICI bandwidth wins come from.
 
 A compressor transforms the *local* gradient before the collective and
 inverse-transforms after; persistent state (error-feedback residual,
@@ -83,6 +84,12 @@ class HorovodCompressorEF(Compressor):
     """
 
     def init_state(self, var_value):
+        import numpy as np
+        if var_value.dtype != np.float32:
+            # reduce() falls through to the plain collective for
+            # non-f32 grads: a residual would be dead HBM per var (and
+            # the simulator's memory estimate would count it)
+            return {}
         return {'residual': jnp.zeros(var_value.shape, jnp.float32)}
 
     def reduce(self, grad, env, reduce_fn):
@@ -97,25 +104,63 @@ class HorovodCompressorEF(Compressor):
         return reduce_fn(compressed).astype(jnp.float32)
 
 
-def _quantize_int8(x):
-    """Symmetric per-tensor int8 quantization; returns (q, scale)."""
-    scale = jnp.max(jnp.abs(x)) / 127.0 + 1e-30
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+def quant_block_size():
+    """Elements per int8 quantization block (``AUTODIST_QUANT_BLOCK``).
+
+    One f32 scale per block: EQuARX-style block quantization bounds an
+    outlier's damage to its own block instead of the whole tensor (or,
+    on the bucketed sync path, the whole multi-variable bucket)."""
+    from autodist_tpu.const import ENV
+    return ENV.AUTODIST_QUANT_BLOCK.val
 
 
-def int8_ring_all_reduce(x, axis_name):
-    """Bandwidth-optimal int8-wire all-reduce (sum).
+def _quantize_int8_blocks(x, block):
+    """Symmetric per-BLOCK int8 quantization of a flat f32 vector.
+
+    Pads to a block multiple and returns ``(q [nb, block] int8,
+    scales [nb] f32)``; the pad region quantizes to zeros and is
+    sliced off by :func:`_dequantize_int8_blocks`."""
+    flat = jnp.ravel(x).astype(jnp.float32)
+    nb = -(-flat.size // block)
+    flat = jnp.pad(flat, (0, nb * block - flat.size))
+    blocks = flat.reshape(nb, block)
+    scales = jnp.max(jnp.abs(blocks), axis=1) / 127.0 + 1e-30
+    q = jnp.clip(jnp.round(blocks / scales[:, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+def _dequantize_int8_blocks(q, scales, size):
+    """Inverse of :func:`_quantize_int8_blocks` (flat f32, pad removed)."""
+    return (q.astype(jnp.float32) *
+            scales[:, None]).reshape(-1)[:size]
+
+
+def block_roundtrip(x, block=None):
+    """What a block-quantized int8 wire actually carries for ``x``:
+    dequantize(quantize(x)), same shape. The error-feedback residual is
+    ``x - block_roundtrip(x)`` — exactly the mass the wire dropped."""
+    block = block or quant_block_size()
+    q, scales = _quantize_int8_blocks(x, block)
+    return _dequantize_int8_blocks(q, scales, jnp.ravel(x).size) \
+        .reshape(x.shape)
+
+
+def int8_ring_all_reduce(x, axis_name, block=None):
+    """Bandwidth-optimal int8-wire all-reduce (sum), block-quantized.
 
     Ring reduce-scatter with per-hop requantization — each hop ships one
-    int8 chunk (+ one f32 scale) instead of f32 data, a ~4x wire saving —
-    followed by an int8 all-gather of the fully-reduced chunks. Per-hop
-    requantization keeps the growing partial sums in range (the EQuARX
-    recipe); callers carry an error-feedback residual for unbiasedness.
+    int8 chunk (+ one f32 scale per ``block`` elements) instead of f32
+    data, a ~4x wire saving — followed by an int8 all-gather of the
+    fully-reduced chunks. Per-hop requantization keeps the growing
+    partial sums in range (the EQuARX recipe), and per-BLOCK scales
+    bound an outlier's quantization damage to its own block; callers
+    carry an error-feedback residual for unbiasedness.
     """
     n = axis_size(axis_name)
     if n == 1:
         return x
+    block = block or quant_block_size()
     shape = x.shape
     flat = jnp.ravel(x).astype(jnp.float32)
     m = -(-flat.size // n)
@@ -128,20 +173,37 @@ def int8_ring_all_reduce(x, axis_name):
     # chunk (i+1) % n
     cur = jax.lax.dynamic_index_in_dim(chunks, me, 0, keepdims=False)
     for step in range(n - 1):
-        q, scale = _quantize_int8(cur)
+        q, scales = _quantize_int8_blocks(cur, block)
         q = jax.lax.ppermute(q, axis_name, perm)
-        scale = jax.lax.ppermute(scale, axis_name, perm)
+        scales = jax.lax.ppermute(scales, axis_name, perm)
         idx = (me - step - 1) % n
-        cur = q.astype(jnp.float32) * scale + \
+        cur = _dequantize_int8_blocks(q, scales, m) + \
             jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
 
-    q, scale = _quantize_int8(cur)
-    all_q = jax.lax.all_gather(q, axis_name)        # [n, m] int8 wire
-    all_s = jax.lax.all_gather(scale, axis_name)    # [n]
-    full = all_q.astype(jnp.float32) * all_s[:, None]
+    q, scales = _quantize_int8_blocks(cur, block)
+    all_q = jax.lax.all_gather(q, axis_name)        # [n, nb, block] int8
+    all_s = jax.lax.all_gather(scales, axis_name)   # [n, nb]
+    full = (all_q.astype(jnp.float32) *
+            all_s[:, :, None]).reshape(n, -1)[:, :m]
     # device row j holds chunk (j+1)%n -> chunk c sits at row (c-1)%n
     full = full[jnp.asarray([(c - 1) % n for c in range(n)])]
     return full.reshape(-1)[:x.size].reshape(shape)
+
+
+def int8_bucket_fusable(compressor, dtype, size):
+    """THE bucket-fusion predicate for the int8 tier, shared by
+    ``plan.sync_gradients`` (runtime emission) and
+    ``plan.static_collective_schedule`` (what the simulator prices) so
+    the two can never drift. True only for f32 tensors at or above
+    ``MIN_SIZE``: smaller tensors have no error-feedback residual
+    (``init_state``) and must keep the plain lossless collective —
+    riding a quantized bucket uncompensated would put a systematic,
+    never-corrected bias on exactly the small, sensitive parameters
+    (biases, norm scales)."""
+    import numpy as np
+    return (type(compressor) is Int8RingCompressor and
+            np.dtype(dtype) == np.float32 and
+            size >= Int8RingCompressor.MIN_SIZE)
 
 
 @register
@@ -150,17 +212,27 @@ class Int8RingCompressor(Compressor):
 
     The reference's compressor tier stops at fp16 casts; this is the
     quantized-collective extension (SURVEY.md §7 stage 4): gradients ride
-    the ring as int8 + per-chunk scales (~4x fewer wire bytes than f32),
-    and the quantization error is carried to the next step, keeping
-    training unbiased over time. Tensors below MIN_SIZE (or non-f32) fall
-    through to the plain collective — no wire saving to be had there.
+    the ring as int8 + per-block f32 scales (``AUTODIST_QUANT_BLOCK``
+    elements each — ~4x fewer wire bytes than f32, an outlier only
+    poisons its own block), and the quantization error is carried to the
+    next step, keeping training unbiased over time. Tensors below
+    MIN_SIZE (or non-f32) fall through to the plain collective — no wire
+    saving to be had there.
+
+    Same-group f32 variables under this compressor are additionally
+    BUCKET-fusable (``plan.sync_gradients``): the packed bucket is
+    quantized as one vector with per-block scales and ONE collective,
+    with each member's error-feedback residual carried separately in
+    aux-state — see :meth:`~autodist_tpu.parallel.plan.ExecutionPlan.
+    sync_gradients`.
     """
 
     MIN_SIZE = 128
 
     def init_state(self, var_value):
         import numpy as np
-        if np.prod(var_value.shape, dtype=int) < self.MIN_SIZE:
+        if var_value.dtype != np.float32 or \
+                np.prod(var_value.shape, dtype=int) < self.MIN_SIZE:
             return {}
         return {'residual': jnp.zeros(var_value.shape, jnp.float32)}
 
@@ -170,8 +242,7 @@ class Int8RingCompressor(Compressor):
         key = 'compressor/%s' % self.var_name
         residual = env.aux_state[key]['residual']
         compensated = grad + residual
-        q, scale = _quantize_int8(compensated)
-        transmitted = q.astype(jnp.float32) * scale
+        transmitted = block_roundtrip(compensated)
         env.aux_updates[key] = {'residual': compensated - transmitted}
         n = axis_size(AXIS_DATA)
         return int8_ring_all_reduce(transmitted, AXIS_DATA) / n

@@ -131,8 +131,11 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     field: ``{'kind', 'group', 'compressor', 'dtype', 'spec', 'vars',
     'bytes', 'members', 'phase'}`` where ``phase`` is ``'grad'``
     (gradient sync) or ``'param'`` (ZeRO param all-gather). ``bytes``
-    are RAW tensor bytes (the wire may be smaller under a compressor —
-    the cost model applies the wire factor). Sparse (embedding) vars
+    are RAW tensor bytes; anything REPORTING traffic must route them
+    through ``simulator.cost_model.wire_bytes`` (as the cost model,
+    ``profiling.bucket_report`` and ``bench.py`` do) — under a
+    compressed wire the raw figure overstates by 2-4x. Sparse
+    (embedding) vars
     assume ``sparse_lookups_per_replica`` looked-up rows per step, the
     runtime's data-dependent quantity.
     """
@@ -226,8 +229,10 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
             entries.append(entry('sparse_all_gather', plan, sparse_bytes,
                                  [var.name]))
         elif plan.is_ar and plan.group is not None and \
-                type(plan.compressor) in (comp.NoneCompressor,
-                                          comp.HorovodCompressor):
+                (type(plan.compressor) in (comp.NoneCompressor,
+                                           comp.HorovodCompressor) or
+                 comp.int8_bucket_fusable(plan.compressor, var.dtype,
+                                          size)):
             key = (plan.group, cname, str(np.dtype(var.dtype)), plan.spec)
             fusable.setdefault(key, []).append(i)
         else:
@@ -367,9 +372,11 @@ class ExecutionPlan:
         self._pure_sparse_cache = {}
         # per-bucket accounting from the most recent sync_gradients
         # trace: [{'kind', 'group', 'compressor', 'dtype', 'spec',
-        # 'vars', 'bytes'}] — surfaced by bench.py and
-        # utils/profiling.bucket_report so the bucket layout (and the
-        # overlap it enables) is auditable without reading HLO.
+        # 'vars', 'bytes'}] — 'bytes' are RAW tensor bytes; bench.py
+        # and utils/profiling.bucket_report attach the wire figure via
+        # simulator.cost_model.wire_bytes so the bucket layout (and the
+        # overlap + compression it enables) is auditable without
+        # reading HLO.
         self.last_bucket_stats = []
         # loose-mode gate: any sync=True var demands its staleness bound;
         # the program-wide gate enforces the tightest one (per-variable
@@ -593,8 +600,10 @@ class ExecutionPlan:
                 out[i] = self._sparse_allreduce(grad, ids)
                 plan.sparse_synced = True
             elif (plan.is_ar and plan.group is not None and
-                    type(plan.compressor) in (comp.NoneCompressor,
-                                              comp.HorovodCompressor)):
+                    (type(plan.compressor) in (comp.NoneCompressor,
+                                               comp.HorovodCompressor) or
+                     comp.int8_bucket_fusable(plan.compressor,
+                                              grad.dtype, grad.size))):
                 key = (plan.group, type(plan.compressor).__name__,
                        str(grad.dtype), plan.spec)
                 fusable.setdefault(key, []).append(i)
@@ -633,19 +642,65 @@ class ExecutionPlan:
                 continue
             flats = [grads[i].reshape(-1) for i in bucket]
             sizes = [f.shape[0] for f in flats]
-            buf = jnp.concatenate(flats)
-            if cname == 'HorovodCompressor' and \
-                    buf.dtype == jnp.float32:
-                buf = self._reduce_fn(spec)(
-                    buf.astype(jnp.bfloat16)).astype(jnp.float32)
+            if cname == 'Int8RingCompressor':
+                buf = self._int8_bucket_reduce(bucket, sources, flats,
+                                               env)
             else:
-                buf = self._reduce_fn(spec)(buf)
+                buf = jnp.concatenate(flats)
+                if cname == 'HorovodCompressor' and \
+                        buf.dtype == jnp.float32:
+                    buf = self._reduce_fn(spec)(
+                        buf.astype(jnp.bfloat16)).astype(jnp.float32)
+                else:
+                    buf = self._reduce_fn(spec)(buf)
             offset = 0
             for i, size in zip(bucket, sizes):
                 out[i] = buf[offset:offset + size].reshape(
                     grads[i].shape)
                 offset += size
         return out
+
+    def _int8_bucket_reduce(self, bucket, sources, flats, env):
+        """Quantized-collective reduction of ONE packed bucket.
+
+        The whole bucket is quantized as a single vector with per-block
+        scales (``AUTODIST_QUANT_BLOCK`` elements per f32 scale — an
+        outlier gradient poisons only its own block, not every member of
+        the bucket) and rides one block-quantized int8 ring all-reduce
+        with per-hop requantization. Error feedback stays PER MEMBER:
+        each variable's residual from aux-state is added to its slice
+        before quantization, and the slice of what the wire dropped is
+        written back as that member's next-step residual. The fusion
+        predicate (``compressor.int8_bucket_fusable``) only admits
+        members with a residual (f32, >= ``MIN_SIZE``) — the
+        missing-residual branch below is a safety net for callers with
+        uninitialized aux-state (bench harnesses), not a sanctioned
+        uncompensated mode. Returns the reduced (mean) flat bucket
+        buffer, ready to slice back into member shapes.
+        """
+        aux = getattr(env, 'aux_state', None) or {}
+        comp_flats, res_keys = [], []
+        for i, flat in zip(bucket, flats):
+            key = 'compressor/%s' % sources[i].name
+            res = (aux.get(key) or {}).get('residual')
+            if res is not None:
+                flat = flat + res.reshape(-1)
+                res_keys.append(key)
+            else:
+                res_keys.append(None)
+            comp_flats.append(flat)
+        buf = jnp.concatenate(comp_flats)
+        transmitted = comp.block_roundtrip(buf)
+        offset = 0
+        for i, key, flat in zip(bucket, res_keys, comp_flats):
+            size = flat.shape[0]
+            if key is not None:
+                env.aux_updates[key] = {'residual': (
+                    flat - transmitted[offset:offset + size]
+                ).reshape(self.plan_for(sources[i]).var.shape)}
+            offset += size
+        n = self.num_replicas
+        return comp.int8_ring_all_reduce(transmitted, AXIS_DATA) / n
 
     # -- padded physical layout (uneven partitions) ------------------------
     def padded_shape(self, var_name):

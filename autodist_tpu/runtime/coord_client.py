@@ -15,10 +15,22 @@ staleness``. A fast worker can thus run at most ``staleness`` steps ahead
 
 The tensor data plane (:meth:`CoordClient.vset` / ``vget`` / ``vadd`` /
 ``vstep``) speaks length-prefixed binary frames: a text header line
-declaring the byte count, then the raw tensor bytes — f32 or bf16 on the
-wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at rest on the service. This is
-the grpc-data-plane equivalent the reference rode for PS traffic; base64
-text framing (33% inflation, full-line buffering) is gone.
+declaring the byte count, then the raw tensor bytes — f32, bf16 or
+block-quantized i8 on the wire (``AUTODIST_PS_WIRE_DTYPE``), f32 at
+rest on the service. This is the grpc-data-plane equivalent the
+reference rode for PS traffic; base64 text framing (33% inflation,
+full-line buffering) is gone.
+
+The ``i8`` wire (EQuARX-style blockscale: ``u32 block, u32 n, f32
+scales x ceil(n/block), int8 q x n`` — one f32 scale per
+``AUTODIST_QUANT_BLOCK`` int8 values) is a PUSH-direction format:
+deltas/gradients quantize to ~1/4 the f32 bytes, the service
+accumulates at f32 rest, and the session carries a host-side
+error-feedback residual per pushed delta (runtime/session.py) so loose
+mode stays convergent. Pulls and authoritative stores under an ``i8``
+setting ride f32 (quantizing at-rest state or reads would compound
+error with no residual to absorb it) — see
+docs/design/quantized-wire.md.
 
 Row-sparse forms (:meth:`CoordClient.vsadd` / ``vgetrows`` and their
 batched ``vmsadd`` / ``vmgetrows``) move only the TOUCHED rows of an
@@ -111,15 +123,32 @@ def coord_token():
 
 
 def _wire_dtype(wire=None):
-    """Resolve the wire dtype name ('f32'|'bf16')."""
+    """Resolve the wire dtype name ('f32'|'bf16'|'i8')."""
     wire = wire or ENV.AUTODIST_PS_WIRE_DTYPE.val
-    if wire not in ('f32', 'bf16'):
+    if wire not in ('f32', 'bf16', 'i8'):
         raise ValueError('unsupported PS wire dtype %r' % wire)
     if wire == 'bf16' and _BF16 is None:  # pragma: no cover
         logging.warning('bf16 wire requested but ml_dtypes is missing; '
                         'falling back to f32')
         return 'f32'
     return wire
+
+
+def _pull_wire(wire=None):
+    """The wire dtype for PULLS and authoritative STORES: i8 is a
+    push-direction (delta) format — quantizing reads or at-rest state
+    would compound error with no error-feedback residual to absorb it —
+    so an ``i8`` setting downgrades to f32 here; f32/bf16 pass
+    through."""
+    wire = _wire_dtype(wire)
+    return 'f32' if wire == 'i8' else wire
+
+
+def _quant_block():
+    """Elements per f32 scale in i8 blockscale frames
+    (``AUTODIST_QUANT_BLOCK``; each frame also carries its block size,
+    so decode never depends on this process's setting)."""
+    return ENV.AUTODIST_QUANT_BLOCK.val
 
 
 def _as_f32_flat(value):
@@ -140,10 +169,31 @@ def _encode(arr, wire):
 
     The f32 path returns a zero-copy memoryview over the source array
     (``tobytes`` paid a full payload copy per frame); callers must not
-    mutate the source until the frame is sent."""
+    mutate the source until the frame is sent. The i8 path emits the
+    blockscale frame ``u32 block, u32 n, f32 scales, int8 q``
+    (symmetric per-block quantization, round-half-to-even like the
+    service's own encoder)."""
     arr = _as_f32_flat(arr)
     if wire == 'bf16':
         return arr.astype(_BF16).tobytes()
+    if wire == 'i8':
+        import struct
+        block = _quant_block()
+        n = arr.size
+        nb = -(-n // block)
+        padded = np.zeros(nb * block, np.float32)
+        padded[:n] = arr
+        blocks = padded.reshape(nb, block)
+        # float32 throughout: the scale each q multiplies against on
+        # decode (here, in C++, and in wire_roundtrip) must be the
+        # same float32 value, or the error-feedback residual the
+        # session carries would not be exact
+        scales = (np.abs(blocks).max(axis=1) / np.float32(127.0) +
+                  np.float32(1e-30)).astype(np.float32)
+        q = np.clip(np.rint(blocks / scales[:, None]),
+                    -127, 127).astype(np.int8)
+        return (struct.pack('<II', block, n) + scales.tobytes() +
+                q.reshape(-1)[:n].tobytes())
     return memoryview(arr).cast('B')
 
 
@@ -151,7 +201,112 @@ def _decode(raw, wire):
     """Raw wire bytes -> float32 host array."""
     if wire == 'bf16':
         return np.frombuffer(raw, dtype=_BF16).astype(np.float32)
+    if wire == 'i8':
+        import struct
+        block, n = struct.unpack('<II', bytes(raw[:8]))
+        nb = -(-n // block) if block else 0
+        if not block or len(raw) != 8 + nb * 4 + n:
+            raise ValueError('malformed i8 blockscale frame '
+                             '(%d bytes, block=%d n=%d)'
+                             % (len(raw), block, n))
+        scales = np.frombuffer(raw, dtype='<f4', count=nb, offset=8)
+        q = np.frombuffer(raw, dtype=np.int8, count=n,
+                          offset=8 + nb * 4)
+        padded = np.zeros(nb * block, np.float32)
+        padded[:n] = q
+        return (padded.reshape(nb, block) *
+                scales[:, None]).reshape(-1)[:n].copy()
     return np.frombuffer(raw, dtype=np.float32)
+
+
+def _wire_itemsize(wire):
+    """Approximate wire bytes per element (i8 carries a ~4/block scale
+    overhead on top; :func:`wire_nbytes` accounts it exactly)."""
+    return {'bf16': 2, 'i8': 1}.get(wire, 4)
+
+
+def _chunk_elems(wire):
+    """Elements per frame chunk (AUTODIST_PS_CHUNK_BYTES of wire
+    bytes); 0 disables chunking."""
+    limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
+    if not limit:
+        return 0
+    return max(1, limit // _wire_itemsize(wire))
+
+
+def _chunk_ranges(n_elems, wire):
+    """Chunk ranges [(off, count)] covering ``n_elems``; a single
+    (0, n) range means 'send unranged' (whole-tensor frame). Module
+    level so :func:`wire_roundtrip` replicates the EXACT per-frame
+    quantization layout a push produced."""
+    chunk = _chunk_elems(wire)
+    if not chunk or n_elems <= chunk:
+        return [(0, n_elems)]
+    return [(off, min(chunk, n_elems - off))
+            for off in range(0, n_elems, chunk)]
+
+
+def _row_chunk_ranges(nrows, bytes_per_row):
+    """Row-chunk ranges [(off, count)] so no frame exceeds
+    ``AUTODIST_PS_CHUNK_BYTES`` of wire bytes."""
+    limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
+    if not limit or nrows * bytes_per_row <= limit:
+        return [(0, nrows)]
+    per = max(1, limit // bytes_per_row)
+    return [(off, min(per, nrows - off))
+            for off in range(0, nrows, per)]
+
+
+def wire_roundtrip(arr, wire=None):
+    """What the service will STORE for a dense pushed array: the exact
+    ``decode(encode(chunk))`` of every frame a ``vadd``/``vstep`` of
+    ``arr`` emits, reassembled to ``arr``'s shape. f32 is the identity;
+    bf16 is round-to-nearest-even; i8 is the per-chunk blockscale
+    round-trip. The session's error-feedback residual is
+    ``compensated - wire_roundtrip(compensated)`` — exactly the mass
+    the wire dropped, bit-for-bit (the same float32 ops run here and on
+    the service)."""
+    wire = _wire_dtype(wire)
+    arr32 = np.asarray(arr, dtype=np.float32)
+    if wire == 'f32':
+        return arr32
+    flat = _as_f32_flat(arr32)
+    out = np.empty(flat.size, np.float32)
+    for off, count in _chunk_ranges(flat.size, wire):
+        out[off:off + count] = _decode(
+            bytes(_encode(flat[off:off + count], wire)), wire)
+    return out.reshape(arr32.shape)
+
+
+def rows_roundtrip(rows, wire=None):
+    """:func:`wire_roundtrip` for the row-sparse push (``vsadd``):
+    the exact decode of every row-chunk frame's encoded blob, shaped
+    ``[nrows, ncols]`` like the input."""
+    wire = _wire_dtype(wire)
+    rows = np.asarray(rows, dtype=np.float32)
+    if wire == 'f32':
+        return rows
+    out = np.empty_like(rows)
+    row_wire = rows.shape[1] * _wire_itemsize(wire)
+    for off, count in _row_chunk_ranges(rows.shape[0], 4 + row_wire):
+        out[off:off + count] = _decode(
+            bytes(_encode(rows[off:off + count], wire)),
+            wire).reshape(count, -1)
+    return out
+
+
+def wire_nbytes(n_elems, wire=None):
+    """Payload bytes ``n_elems`` floats occupy on the given wire,
+    including the i8 blockscale overhead (8-byte header + one f32
+    scale per ``AUTODIST_QUANT_BLOCK`` elements, per chunk frame)."""
+    wire = _wire_dtype(wire)
+    if wire != 'i8':
+        return n_elems * _wire_itemsize(wire)
+    block = _quant_block()
+    total = 0
+    for _, count in _chunk_ranges(n_elems, wire):
+        total += 8 + 4 * (-(-count // block)) + count
+    return total
 
 
 def ensure_service(port=DEFAULT_COORD_PORT, wait_s=10.0, bind='127.0.0.1'):
@@ -526,19 +681,12 @@ class CoordClient:
     def _chunk_elems(wire):
         """Elements per frame chunk (AUTODIST_PS_CHUNK_BYTES of wire
         bytes); 0 disables chunking."""
-        limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
-        if not limit:
-            return 0
-        return max(1, limit // (2 if wire == 'bf16' else 4))
+        return _chunk_elems(wire)
 
     def _ranges(self, n_elems, wire):
         """Chunk ranges [(off, count)] covering ``n_elems``; a single
         (0, n) range means 'send unranged' (whole-tensor frame)."""
-        chunk = self._chunk_elems(wire)
-        if not chunk or n_elems <= chunk:
-            return [(0, n_elems)]
-        return [(off, min(chunk, n_elems - off))
-                for off in range(0, n_elems, chunk)]
+        return _chunk_ranges(n_elems, wire)
 
     def _set_frames(self, key, value, wire):
         """The BSET frame sequence for one tensor (chunked like vset)."""
@@ -564,8 +712,13 @@ class CoordClient:
         """Pipelined multi-tensor :meth:`vset`: every (key, value) in
         ``items`` is stored with vset's exact chunking, but all request
         frames are written ahead of draining the replies — one wire
-        round trip for the whole batch instead of one per chunk."""
-        wire = _wire_dtype(wire)
+        round trip for the whole batch instead of one per chunk.
+
+        Stores are AUTHORITATIVE state, so an ``i8`` wire setting
+        rides f32 here (:func:`_pull_wire`): quantizing at-rest values
+        would corrupt them permanently, with no error-feedback residual
+        to absorb it."""
+        wire = _pull_wire(wire)
         frames = [f for key, value in items
                   for f in self._set_frames(key, value, wire)]
         errs = []
@@ -624,8 +777,12 @@ class CoordClient:
         may come from consecutive pushes — fine for commutative BADD
         accumulation and for fetch-side staleness, but a reader that
         needs one specific BSET snapshot must quiesce writers (the
-        staleness gate) rather than rely on this path."""
-        wire = _wire_dtype(wire)
+        staleness gate) rather than rely on this path.
+
+        Pulls are the READ direction: an ``i8`` wire setting rides f32
+        here (:func:`_pull_wire`) — only pushes quantize, under the
+        session's error-feedback residual."""
+        wire = _pull_wire(wire)
         specs = list(specs)
         n_elems = [int(np.prod(shp)) if shp is not None else None
                    for _, shp in specs]
@@ -776,22 +933,23 @@ class CoordClient:
     # -- row-sparse tensor plane (embedding variables) ---------------------
     @staticmethod
     def _wire_itemsize(wire):
-        return 2 if wire == 'bf16' else 4
+        return _wire_itemsize(wire)
 
     def _row_chunks(self, nrows, bytes_per_row):
         """Row-chunk ranges [(off, count)] so no frame exceeds
         ``AUTODIST_PS_CHUNK_BYTES`` of wire bytes (indices + row data
         for pushes, row data for row fetches)."""
-        limit = ENV.AUTODIST_PS_CHUNK_BYTES.val
-        if not limit or nrows * bytes_per_row <= limit:
-            return [(0, nrows)]
-        per = max(1, limit // bytes_per_row)
-        return [(off, min(per, nrows - off))
-                for off in range(0, nrows, per)]
+        return _row_chunk_ranges(nrows, bytes_per_row)
 
     def _sadd_frames(self, key, indices, rows, wire):
         """The BSADD frame sequence for one row-sparse push (chunked
-        over ROWS like vset chunks over elements)."""
+        over ROWS like vset chunks over elements).
+
+        f32/bf16 declare the per-row wire bytes; i8 blockscale blobs
+        are not per-row divisible (the scales header spans the chunk),
+        so those frames declare the TOTAL blob length instead and the
+        service derives cols from decoded elements / nrows — the
+        protocol note in coord_service.cc's header."""
         idx = np.asarray(indices, dtype=np.int32).reshape(-1)
         if not idx.flags.c_contiguous:
             idx = np.ascontiguousarray(idx)
@@ -807,10 +965,11 @@ class CoordClient:
                 ' %d %d' % (off, idx.size)
             # scatter-gather payload: int32 indices then the row data,
             # no concat copy of the rows (the f32 path is a memoryview)
-            payload = [memoryview(idx[off:off + count]).cast('B'),
-                       _encode(rows[off:off + count], wire)]
+            blob = _encode(rows[off:off + count], wire)
+            declared = len(blob) if wire == 'i8' else row_wire
+            payload = [memoryview(idx[off:off + count]).cast('B'), blob]
             yield (key, 'BSADD %s %d %d %s%s'
-                   % (key, count, row_wire, wire, suffix), payload)
+                   % (key, count, declared, wire, suffix), payload)
 
     def vsadd(self, key, indices, rows, wire=None):
         """Row-sparse scatter-add: ``rows[r]`` is added into row
@@ -865,8 +1024,9 @@ class CoordClient:
         died-mid-push signature and raises. A version that keeps
         MOVING but stays even means whole pushes keep landing — the
         final assembly is returned (benign element-level staleness,
-        same caveat as vmget's)."""
-        wire = _wire_dtype(wire)
+        same caveat as vmget's). Reads ride f32 under an ``i8``
+        setting, like :meth:`vmget`."""
+        wire = _pull_wire(wire)
         specs = [(key, np.ascontiguousarray(
                      np.asarray(idx, dtype=np.int32).reshape(-1)),
                   int(ncols)) for key, idx, ncols in specs]

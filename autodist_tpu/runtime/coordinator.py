@@ -28,6 +28,9 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_HEARTBEAT_TIMEOUT,
                     ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
                     ENV.AUTODIST_PS_CHUNK_BYTES,
+                    # quantization block layout is part of the traced
+                    # program (compressor) AND the PS frame format
+                    ENV.AUTODIST_QUANT_BLOCK,
                     ENV.AUTODIST_S2D_STEM, ENV.AUTODIST_DENSENET_DUS,
                     # bucket layout + overlap flags must agree on every
                     # traced host — divergent HLO across SPMD deadlocks

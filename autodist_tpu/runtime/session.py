@@ -443,8 +443,19 @@ class Session:
         self._ps_addrs = []
         self._ps_index = {}
         self._ps_bytes = 0
+        self._ps_push_bytes = 0
+        self._ps_pull_bytes = 0
         self._ps_ep_bytes = []
         self._ps_seconds = 0.0
+        # quantized-push error feedback (AUTODIST_PS_WIRE_DTYPE=i8):
+        # per-variable host-side residual of the mass the last push's
+        # block quantization dropped, added back into the next delta
+        # before classification so loose mode stays convergent. Only
+        # touched by the push path (pipeline thread at depth 2 —
+        # pushes are serialized through the pipeline join). Transient:
+        # not checkpointed (worst case one push's quantization error
+        # is lost on restart, bounded by a block's scale).
+        self._push_residual = {}
         # async pipeline (AUTODIST_PS_PIPELINE_DEPTH >= 2): step N's
         # delta push + publish and step N+1's variable pull run on a
         # dedicated background thread; run() only joins the result.
@@ -1092,6 +1103,10 @@ class Session:
         with self._stats_lock:
             ph = dict(self._ps_phase)
             out = {'bytes': self._ps_bytes, 'seconds': self._ps_seconds,
+                   # direction split: the quantized (i8) wire only
+                   # shrinks pushes, so A/Bs must compare push bytes
+                   'push_bytes': self._ps_push_bytes,
+                   'pull_bytes': self._ps_pull_bytes,
                    'bytes_per_endpoint': list(self._ps_ep_bytes),
                    'mb_per_s': (self._ps_bytes / 1e6 / self._ps_seconds
                                 if self._ps_seconds else 0.0),
@@ -1399,9 +1414,17 @@ class Session:
         return results[0] if single else results
 
     # -- loose-mode PS data plane -----------------------------------------
-    def _wire_nbytes(self, n_elems):
-        from autodist_tpu.runtime.coord_client import _wire_dtype
-        return n_elems * (2 if _wire_dtype() == 'bf16' else 4)
+    def _wire_nbytes(self, n_elems, push=False):
+        """Wire bytes ``n_elems`` floats cost in the given direction.
+
+        The i8 wire is push-only (deltas/gradients quantize under the
+        session's error-feedback residual); pulls and stores ride f32
+        under an i8 setting (coord_client._pull_wire), so pull-side
+        accounting must price the downgraded dtype, not the env
+        setting."""
+        from autodist_tpu.runtime import coord_client as cc
+        wire = cc._wire_dtype() if push else cc._pull_wire()
+        return cc.wire_nbytes(n_elems, wire)
 
     def _join_pipeline(self):
         """Join the in-flight background push job (pipeline depth >= 2)
@@ -1583,6 +1606,7 @@ class Session:
                 self._account_ep_bytes(name)
             self._ps_seconds += prefetch['wire_s']
             self._ps_bytes += self._wire_nbytes(n_elems)
+            self._ps_pull_bytes += self._wire_nbytes(n_elems)
             self._ps_phase['discarded_prefetches'] += 1
 
     def _pull_ps_vars(self, prefetch=None, train=True):
@@ -1636,6 +1660,7 @@ class Session:
         with self._stats_lock:
             self._ps_seconds += wire_s
             self._ps_bytes += self._wire_nbytes(n_elems)
+            self._ps_pull_bytes += self._wire_nbytes(n_elems)
             if train:
                 self._ps_phase['pull_s'] += wire_s
                 self._ps_phase['exposed_wait_s'] += exposed_s
@@ -1730,16 +1755,39 @@ class Session:
         step index makes those inherently sequential). At pipeline
         depth >= 2 this whole method runs on the background pipeline
         thread, including the device->host readback of the updated
-        state."""
+        state.
+
+        Under the quantized push wire (``AUTODIST_PS_WIRE_DTYPE=i8``)
+        every pushed delta/gradient carries error feedback: the
+        residual the LAST push's block quantization dropped is added
+        back before classification (so accumulated error flushes even
+        through variables whose raw delta is zero this step), and the
+        new residual — ``compensated - wire_roundtrip(compensated)``,
+        bit-exactly the mass the service did not receive — is kept for
+        the next push. BADD/BSADD accumulate at f32 rest, so only this
+        push direction quantizes; pulls stay f32."""
         import time as _time
+
+        from autodist_tpu.runtime import coord_client as cc
         t0 = _time.perf_counter()
-        shared_push = shared_push or {}
+        shared_push = dict(shared_push or {})
+        push_wire = cc._wire_dtype()
+        lossy = push_wire == 'i8'
         afters = {name: np.asarray(self._local_value(name),
                                    dtype=np.float32)
                   for name in pulled if name not in shared_push}
         deltas = {name: after - np.asarray(pulled[name],
                                            dtype=np.float32)
                   for name, after in afters.items()}
+        if lossy:
+            for name in list(deltas):
+                res = self._push_residual.get(name)
+                if res is not None:
+                    deltas[name] = deltas[name] + res
+            for name, (g, rule, params) in list(shared_push.items()):
+                res = self._push_residual.get(name)
+                if res is not None:
+                    shared_push[name] = (g + res, rule, params)
         zero_skip, sparse_rows = self._classify_push(deltas)
         groups, _ = self._transfer_groups(list(pulled))
 
@@ -1750,6 +1798,15 @@ class Session:
         wire_bytes = 0
         rows_pushed = 0
         bytes_avoided = 0
+        # Residual bookkeeping quantizes each pushed array once here
+        # (wire_roundtrip) and once more when the client encodes the
+        # actual frames — a deliberate trade: sharing one encode pass
+        # would thread pre-encoded blobs through vmadd/vmsadd/vstep's
+        # framing, and the extra pass is host CPU the depth-2 pipeline
+        # already hides, while the roundtrip helper guarantees the
+        # residual is bit-exactly what the service decodes.
+        res_parts = {}   # name -> [per-shard residual part] (dense)
+        new_res = {}     # name -> full-shape residual (sparse path)
         for ep, units in groups.items():
             job = ep_jobs.setdefault(
                 ep, {'steps': [], 'adds': [], 'sadds': []})
@@ -1760,23 +1817,29 @@ class Session:
                         g = pc.split(g)[i]
                     job['steps'].append(
                         (self._key(key), g, rule, params))
-                    nb = self._wire_nbytes(g.size)
+                    nb = self._wire_nbytes(g.size, push=True)
+                    if lossy:
+                        parts = res_parts.setdefault(
+                            name,
+                            [None] * len(self._shard_info(name)[1]))
+                        parts[i] = g - cc.wire_roundtrip(g, push_wire)
                 elif name in zero_skip:
                     full = deltas[name] if pc is None else \
                         pc.split(deltas[name])[i]
-                    bytes_avoided += self._wire_nbytes(full.size)
+                    bytes_avoided += self._wire_nbytes(full.size,
+                                                       push=True)
                     continue
                 elif name in sparse_rows:
                     delta = deltas[name]
                     idx = sparse_rows[name]
                     if pc is None:
-                        local, rows = idx, delta[idx]
+                        sel, local, rows = idx, idx, delta[idx]
                     else:
                         starts = self._shard_row_starts(name, pc)
                         lo, hi = starts[i], starts[i + 1]
                         sel = idx[(idx >= lo) & (idx < hi)]
                         dense_nb = self._wire_nbytes(
-                            (hi - lo) * delta.shape[1])
+                            (hi - lo) * delta.shape[1], push=True)
                         if sel.size == 0:
                             bytes_avoided += dense_nb
                             continue
@@ -1784,19 +1847,46 @@ class Session:
                         rows = delta[sel]
                     job['sadds'].append((self._key(key), local, rows))
                     nb = local.size * 4 + \
-                        self._wire_nbytes(rows.size)
+                        self._wire_nbytes(rows.size, push=True)
                     dense_elems = (delta.shape[0] if pc is None
                                    else hi - lo) * delta.shape[1]
-                    bytes_avoided += self._wire_nbytes(dense_elems) - nb
+                    bytes_avoided += self._wire_nbytes(
+                        dense_elems, push=True) - nb
                     rows_pushed += local.size
+                    if lossy:
+                        res = new_res.setdefault(
+                            name, np.zeros_like(delta))
+                        res[sel] = rows - cc.rows_roundtrip(rows,
+                                                            push_wire)
                 else:
                     delta = deltas[name]
                     if pc is not None:
                         delta = pc.split(delta)[i]
                     job['adds'].append((self._key(key), delta))
-                    nb = self._wire_nbytes(delta.size)
+                    nb = self._wire_nbytes(delta.size, push=True)
+                    if lossy:
+                        parts = res_parts.setdefault(
+                            name,
+                            [None] * len(self._shard_info(name)[1]))
+                        parts[i] = delta - cc.wire_roundtrip(
+                            delta, push_wire)
                 wire_bytes += nb
                 ep_bytes[ep] += nb
+        if lossy:
+            # Reassemble and retire residuals: a zero compensated delta
+            # means the accumulated error was fully flushed (or never
+            # existed); merge partitioned shards back to logical shape.
+            for name in zero_skip:
+                self._push_residual.pop(name, None)
+            for name, parts in res_parts.items():
+                pc, _ = self._shard_info(name)
+                new_res[name] = parts[0] if pc is None else \
+                    pc.merge(parts)
+            for name, res in new_res.items():
+                if np.any(res):
+                    self._push_residual[name] = res
+                else:
+                    self._push_residual.pop(name, None)
 
         def push_group(job):
             def go(client):
@@ -1818,6 +1908,7 @@ class Session:
         # workers touched converge via the periodic full refresh
         # (AUTODIST_SPARSE_FULL_REFRESH_EVERY); a zero push leaves the
         # cache as is on the same schedule.
+        push_only_bytes = wire_bytes
         refresh_bytes, refresh_ep = self._refresh_proxies(
             zero_skip, sparse_rows)
         wire_bytes += refresh_bytes
@@ -1831,6 +1922,11 @@ class Session:
                 self._ps_ep_bytes[ep] += nb
             self._ps_seconds += push_s
             self._ps_bytes += wire_bytes
+            # direction split: the proxy refresh is READ traffic even
+            # though it rides the push phase, so the quantized-push
+            # A/B (bench_quantized) can compare pure push bytes
+            self._ps_push_bytes += push_only_bytes
+            self._ps_pull_bytes += refresh_bytes
             self._ps_phase['push_s'] += push_s
             ss = self._sparse_stats
             ss['sparse_pushes'] += len(sparse_rows)

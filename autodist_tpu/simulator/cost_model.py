@@ -35,13 +35,19 @@ from autodist_tpu.utils import logging
 
 #: Wire bytes per element by compressor (None = tensor's own itemsize).
 #: HorovodCompressor casts f32→bf16 for the wire; Int8Ring ships int8
-#: chunks (+negligible scales). PowerSGD's wire is rank-dependent and it
-#: never fuses — priced at full bytes as a conservative bound.
+#: blocks plus one f32 scale per AUTODIST_QUANT_BLOCK elements (the
+#: scale overhead is added by :func:`wire_bytes`, not folded in here).
+#: PowerSGD's wire is rank-dependent and it never fuses — priced at
+#: full bytes (None) as a conservative bound. Keys MUST cover the
+#: compressor registry in :mod:`autodist_tpu.parallel.compressor`
+#: exactly — a compressor missing here would silently price as f32
+#: (tools/check_wire_pricing.py is the tier-1 drift check).
 _WIRE_ITEMSIZE = {
     'NoneCompressor': None,
     'HorovodCompressor': 2,
     'HorovodCompressorEF': 2,
     'Int8RingCompressor': 1,
+    'PowerSGDCompressor': None,
 }
 
 #: Grad + optimizer-slot accounting assumptions: gradients match the
@@ -50,12 +56,22 @@ _OPT_SLOT_ITEMSIZE = 4
 
 
 def wire_bytes(nbytes, dtype, compressor=None):
-    """Bytes that actually cross the wire for a raw ``nbytes`` tensor."""
+    """Bytes that actually cross the wire for a raw ``nbytes`` tensor.
+
+    The block-quantized int8 tier additionally carries one f32 scale
+    per ``AUTODIST_QUANT_BLOCK`` elements (the EQuARX blockscale
+    header) — at the default block of 256 that is ~1.6% on top of the
+    int8 payload, priced here so the 4x headline never overstates."""
     itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
     wire = _WIRE_ITEMSIZE.get(compressor or 'NoneCompressor')
     if wire is None or wire >= itemsize:
         return int(nbytes)
-    return int(nbytes) * wire // itemsize
+    elems = int(nbytes) // itemsize
+    out = elems * wire
+    if compressor == 'Int8RingCompressor':
+        from autodist_tpu.parallel.compressor import quant_block_size
+        out += 4 * (-(-elems // quant_block_size()))
+    return out
 
 
 @dataclass
@@ -86,6 +102,13 @@ class CostModelParams:
     # compressors are not free: the wire cast reads+writes the full
     # tensor at HBM speed on both ends (~800 GB/s, two passes)
     compress_s_per_byte: float = 2.5e-12
+    # block quantization costs MORE than a cast: the max-abs scan, the
+    # scale divide and the per-hop requantization of the int8 ring are
+    # extra HBM passes over the bucket (~2 additional round trips).
+    # Added ON TOP of compress_s_per_byte for Int8RingCompressor
+    # entries — this is what lets a bandwidth-rich ICI topology
+    # correctly REJECT the int8 tier while a DCN-bound one picks it.
+    quant_s_per_byte: float = 5.0e-12
     calibrated: bool = False
 
     @classmethod
@@ -246,6 +269,10 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         t = collective_time(e['kind'], wb, n, alpha, beta)
         if wb < e['bytes']:   # compressor cast: two HBM passes per end
             t += e['bytes'] * params.compress_s_per_byte
+        if e.get('compressor') == 'Int8RingCompressor':
+            # block quantization: max-abs scan + scale divide + the
+            # ring's per-hop requantization — extra HBM passes
+            t += e['bytes'] * params.quant_s_per_byte
         # grad buckets before the last-emitted one overlap backward
         # compute; ZeRO scatters are conservatively priced in full.
         # Param-phase traffic (the post-update re-gather — the static

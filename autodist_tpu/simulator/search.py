@@ -2,7 +2,8 @@
 
 Enumerates the existing builders (and their tunable knobs: AllReduce
 chunk_size — which sets the gradient-bucket byte cap — the bf16-wire
-compressor, RING spec, and the partitioned variants), prices each with
+and block-quantized int8-wire compressors, RING spec, and the
+partitioned variants), prices each with
 :mod:`cost_model`, prunes candidates whose predicted per-device peak
 bytes exceed the memory budget, and returns the rest ranked by
 predicted step time.
@@ -49,6 +50,12 @@ def default_candidates(chunk_sizes=(32, 128, 512)):
     cands += [
         ('AllReduce(bf16-wire)',
          lambda: b.AllReduce(compressor='HorovodCompressor')),
+        # block-quantized int8 collectives (EQuARX tier): ~4x fewer
+        # wire bytes than f32 at an extra quantize/requantize HBM cost
+        # (CostModelParams.quant_s_per_byte) — wins when the link is
+        # bandwidth-bound (DCN), loses on latency-bound ICI
+        ('AllReduce(int8-wire)',
+         lambda: b.AllReduce(compressor='Int8RingCompressor')),
         ('AllReduce(RING)', lambda: b.AllReduce(all_reduce_spec='RING')),
         ('PartitionedAR', lambda: b.PartitionedAR()),
         ('RandomAxisPartitionAR',

@@ -434,15 +434,14 @@ def probed_devices():
     return resolve_devices()[0]
 
 
-def bench_grad_sync(steps=10):
-    """Bucketed gradient-sync microbench (the bucketing scheduler's
-    observable): an AllReduce(chunk_size=2) strategy over 16 synthetic
-    64 KiB gradients lowers to one collective per byte-capped bucket
-    (parallel/plan.py sync_gradients); this times the compiled sync
-    program ALONE — per-step sync time, not step-minus-compute noise —
-    and reports the emitted bucket layout. On a 1-device mesh the sync
-    is an identity program; the bucket layout is then reported from the
-    static packer (same pack_buckets computation the plan runs).
+def _bucketed_sync_program(compressor='NoneCompressor', n_vars=16,
+                           dim=128, chunk=2):
+    """Compile the bucketed gradient-sync program ALONE for an
+    ``AllReduce(chunk_size=chunk, compressor=...)`` strategy over
+    ``n_vars`` synthetic [dim, dim] f32 gradients. The single harness
+    behind bench_grad_sync AND the quantized A/B — one timing/mesh
+    protocol, so f32-vs-int8 comparisons can never drift apart.
+    Returns (compiled fn, grads, plan, static layout, device count).
     """
     import jax
     import jax.numpy as jnp
@@ -458,7 +457,6 @@ def bench_grad_sync(steps=10):
                                                PytreeGraphItem,
                                                grad_bucket_layout)
 
-    n_vars, dim = 16, 128
     devs = probed_devices()
 
     def init_fn(rng):
@@ -469,7 +467,8 @@ def bench_grad_sync(steps=10):
     rs = ResourceSpec(resource_info={'nodes': [{
         'address': 'localhost', 'chief': True, 'cpus': [0],
         'gpus': list(range(len(devs))), 'network_bandwidth': 100}]})
-    strategy = AllReduce(chunk_size=2).build(gi, rs)
+    strategy = AllReduce(chunk_size=chunk,
+                         compressor=compressor).build(gi, rs)
     layout = grad_bucket_layout(strategy, gi)
     mesh = Mesh(np.asarray(devs), (AXIS_DATA,))
     plan = ExecutionPlan(strategy, gi, mesh)
@@ -485,6 +484,13 @@ def bench_grad_sync(steps=10):
 
     f = jax.jit(_shard_map(sync, mesh, tuple(P() for _ in grads),
                            tuple(P() for _ in grads)))
+    return f, grads, plan, layout, len(devs)
+
+
+def _time_sync_program(f, grads, steps):
+    """Median fenced block of ``steps`` sync calls (after a compile +
+    warmup call). Returns (per-block median seconds, last outputs)."""
+    import jax
     outs = f(*grads)
     jax.block_until_ready(outs)   # compile + warmup
     blocks = []
@@ -494,14 +500,158 @@ def bench_grad_sync(steps=10):
             outs = f(*grads)
         jax.block_until_ready(outs)
         blocks.append(time.perf_counter() - t0)
-    med = sorted(blocks)[len(blocks) // 2]
+    return sorted(blocks)[len(blocks) // 2], outs
+
+
+def bench_grad_sync(steps=10):
+    """Bucketed gradient-sync microbench (the bucketing scheduler's
+    observable): an AllReduce(chunk_size=2) strategy over 16 synthetic
+    64 KiB gradients lowers to one collective per byte-capped bucket
+    (parallel/plan.py sync_gradients); this times the compiled sync
+    program ALONE — per-step sync time, not step-minus-compute noise —
+    and reports the emitted bucket layout. On a 1-device mesh the sync
+    is an identity program; the bucket layout is then reported from the
+    static packer (same pack_buckets computation the plan runs).
+    """
+    f, grads, plan, layout, n_devs = _bucketed_sync_program()
+    med, _ = _time_sync_program(f, grads, steps)
     emitted = list(plan.last_bucket_stats) or layout
+    # report the WIRE, not just raw tensor bytes: under a compressed
+    # wire (bf16 cast, int8 blocks) the raw figure overstates the
+    # traffic by 2-4x, hiding exactly the wins this report motivates
+    from autodist_tpu.simulator.cost_model import wire_bytes
+    wire = [wire_bytes(b['bytes'], b.get('dtype'), b.get('compressor'))
+            for b in emitted]
     return {
         'bucket_count': len(emitted),
         'per_step_sync_time_s': round(med / steps, 6),
         'sync_bytes': sum(b['bytes'] for b in emitted),
+        'sync_wire_bytes': sum(wire),
         'bucket_bytes': [b['bytes'] for b in emitted],
-        'devices': len(devs),
+        'bucket_wire_bytes': wire,
+        'devices': n_devs,
+    }
+
+
+def bench_quantized(steps=8):
+    """Block-quantized comms A/B (ISSUE 8 acceptance), both data planes.
+
+    ``grad_sync``: the SAME bucketed gradient-sync program (16 x 64 KiB
+    grads, chunk_size=2) compiled and timed with the f32 wire
+    (NoneCompressor) and the block-quantized int8 wire
+    (Int8RingCompressor, per-block scales + per-hop requantization),
+    reporting raw vs wire bytes per ``cost_model.wire_bytes``, per-step
+    sync time, and the max abs difference of the synced gradients (the
+    quantization error the error-feedback residual absorbs over steps —
+    bounded, not zero).
+
+    ``ps_push``: the SAME single-process loose-mode workload at
+    ``AUTODIST_PS_WIRE_DTYPE=f32`` and ``=i8`` (push direction
+    quantizes under the session's host-side error-feedback residual;
+    pulls stay f32), reporting push-direction bytes-on-wire, per-step
+    wall, and the final-state divergence (bounded by the residual
+    carry).
+
+    Never raises: hosts without g++ degrade the PS half to an error
+    entry so the bench still emits its one JSON line.
+    """
+    out = {}
+    try:
+        out['grad_sync'] = _bench_quantized_grad_sync(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        out['grad_sync'] = {'error': '%s: %s' % (type(e).__name__, e)}
+    try:
+        out['ps_push'] = _bench_quantized_ps_push(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        out['ps_push'] = {'error': '%s: %s' % (type(e).__name__, e)}
+    return out
+
+
+def _bench_quantized_grad_sync(steps):
+    from autodist_tpu.const import ENV
+    from autodist_tpu.simulator.cost_model import wire_bytes
+
+    result = {}
+    outputs = {}
+    n_devs = 0
+    for comp_name, key in (('NoneCompressor', 'f32'),
+                           ('Int8RingCompressor', 'int8')):
+        f, grads, plan, layout, n_devs = \
+            _bucketed_sync_program(compressor=comp_name)
+        med, outs = _time_sync_program(f, grads, steps)
+        emitted = list(plan.last_bucket_stats)
+        outputs[key] = outs
+        result[key] = {
+            'per_step_sync_time_s': round(med / steps, 6),
+            'bucket_count': len(emitted),
+            'sync_bytes': sum(b['bytes'] for b in emitted),
+            'wire_bytes': sum(
+                wire_bytes(b['bytes'], b.get('dtype'),
+                           b.get('compressor')) for b in emitted),
+        }
+    f32_wire = result['f32']['wire_bytes']
+    i8_wire = result['int8']['wire_bytes']
+    result['bytes_reduction'] = round(f32_wire / i8_wire, 2) \
+        if i8_wire else 0.0
+    result['state_max_abs_diff'] = float(max(
+        np.abs(np.asarray(a) - np.asarray(b)).max()
+        for a, b in zip(outputs['f32'], outputs['int8']))) \
+        if outputs['f32'] else 0.0
+    result['quant_block'] = ENV.AUTODIST_QUANT_BLOCK.val
+    result['devices'] = n_devs
+    return result
+
+
+def _bench_quantized_ps_push(steps):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+
+    def run(wire):
+        saved = os.environ.get('AUTODIST_PS_WIRE_DTYPE')
+        os.environ['AUTODIST_PS_WIRE_DTYPE'] = wire
+        try:
+            return _loose_ps_run(1, steps, port)
+        finally:
+            if saved is None:
+                os.environ.pop('AUTODIST_PS_WIRE_DTYPE', None)
+            else:
+                os.environ['AUTODIST_PS_WIRE_DTYPE'] = saved
+
+    try:
+        d32, s32, w32 = run('f32')
+        d8, s8, w8 = run('i8')
+    finally:
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    def block(dt, stats):
+        return {'per_step_wall_s': round(dt, 5),
+                'push_bytes': stats.get('push_bytes', 0),
+                'pull_bytes': stats.get('pull_bytes', 0),
+                'bytes_on_wire': stats['bytes']}
+
+    push32 = s32.get('push_bytes', 0)
+    push8 = s8.get('push_bytes', 0)
+    return {
+        'steps_per_wire': steps,
+        'f32': block(d32, s32),
+        'i8': block(d8, s8),
+        'push_bytes_reduction': round(push32 / push8, 2)
+        if push8 else 0.0,
+        'state_max_abs_diff': float(np.abs(w32 - w8).max()),
     }
 
 
@@ -1326,6 +1476,7 @@ def main():
         result['extra']['recovery'] = bench_recovery()
         result['extra']['sparse_ps'] = bench_sparse_ps()
         result['extra']['elastic'] = bench_elastic()
+        result['extra']['quantized'] = bench_quantized()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -1343,6 +1494,7 @@ def main():
     recovery = bench_recovery()
     sparse_ps = bench_sparse_ps()
     elastic = bench_elastic()
+    quantized = bench_quantized()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -1362,6 +1514,7 @@ def main():
                 'recovery': recovery,
                 'sparse_ps': sparse_ps,
                 'elastic': elastic,
+                'quantized': quantized,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -1416,7 +1569,8 @@ def main():
                       'ps_pipeline': ps_pipeline,
                       'recovery': recovery,
                       'sparse_ps': sparse_ps,
-                      'elastic': elastic},
+                      'elastic': elastic,
+                      'quantized': quantized},
         }
     print(json.dumps(result))
 

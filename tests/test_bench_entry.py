@@ -59,6 +59,19 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert el['admit_wall_s'] > 0
         assert el['state_max_abs_diff'] == 0.0
         assert el['replans']
+    # ISSUE 8: every record carries the quantized A/B under its stable
+    # key — wire bytes measured >= 3x smaller on both data planes,
+    # divergence bounded and reported
+    q = extra['quantized']
+    qg = q['grad_sync']
+    assert 'error' not in qg, qg
+    assert qg['bytes_reduction'] >= 3.0, qg
+    assert qg['state_max_abs_diff'] < 0.05
+    if shutil.which('g++'):
+        qp = q['ps_push']
+        assert 'error' not in qp, qp
+        assert qp['push_bytes_reduction'] >= 3.0, qp
+        assert qp['state_max_abs_diff'] < 0.05
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

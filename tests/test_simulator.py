@@ -92,7 +92,10 @@ def test_predict_golden_single_var_allreduce():
 def test_wire_bytes_compressors():
     assert wire_bytes(4096, 'float32', 'NoneCompressor') == 4096
     assert wire_bytes(4096, 'float32', 'HorovodCompressor') == 2048
-    assert wire_bytes(4096, 'float32', 'Int8RingCompressor') == 1024
+    # int8 blocks carry one f32 scale per AUTODIST_QUANT_BLOCK (256)
+    # elements: 1024 int8 + 4 scales — the 4x headline never overstates
+    assert wire_bytes(4096, 'float32', 'Int8RingCompressor') == \
+        1024 + 4 * 4
     # bf16 params: the bf16 wire cast is a no-op, not a saving
     assert wire_bytes(2048, 'bfloat16', 'HorovodCompressor') == 2048
 

@@ -1,0 +1,57 @@
+"""Compressor wire-pricing drift check.
+
+Asserts that the cost model's ``_WIRE_ITEMSIZE`` table covers the
+compressor registry in ``autodist_tpu/parallel/compressor.py`` exactly.
+A compressor registered but missing from the table would silently price
+as f32 (``wire_bytes`` falls back to the raw itemsize), so the
+simulator could never rank the tier the compressor exists to enable —
+the same failure mode the protocol-drift check (check_protocol.py)
+guards against on the native wire.
+
+Run:  python tools/check_wire_pricing.py      (exit 0 = in sync)
+Wired into tier-1 via tests/test_quantized_wire.py.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def find_drift():
+    """Returns a list of human-readable drift problems (empty = in
+    sync)."""
+    from autodist_tpu.parallel.compressor import _REGISTRY
+    from autodist_tpu.simulator.cost_model import _WIRE_ITEMSIZE
+    registry = set(_REGISTRY)
+    priced = set(_WIRE_ITEMSIZE)
+    problems = []
+    for name in sorted(registry - priced):
+        problems.append('compressor registered but missing from '
+                        'cost_model._WIRE_ITEMSIZE (would silently '
+                        'price as f32): %s' % name)
+    for name in sorted(priced - registry):
+        problems.append('priced in cost_model._WIRE_ITEMSIZE but not '
+                        'in the compressor registry (stale entry): %s'
+                        % name)
+    if not registry:
+        problems.append('compressor registry is empty — the registry '
+                        'moved or the import graph broke')
+    return problems
+
+
+def main(argv=None):
+    problems = find_drift()
+    if problems:
+        print('compressor wire-pricing drift:')
+        for p in problems:
+            print('  - ' + p)
+        return 1
+    from autodist_tpu.parallel.compressor import _REGISTRY
+    print('cost_model._WIRE_ITEMSIZE and the compressor registry '
+          'agree (%d compressors)' % len(_REGISTRY))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
