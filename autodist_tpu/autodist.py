@@ -365,7 +365,8 @@ class AutoDist:
         if dumping:
             viz.log_text(compiled, '2-compiled-strategy')
         plan = ExecutionPlan(compiled, self._original_graph_item, mesh,
-                             loose=loose)
+                             loose=loose,
+                             topology=self._resource_spec.topology)
         described = plan.describe()
         logging.info(described)
         if dumping:

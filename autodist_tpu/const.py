@@ -286,6 +286,24 @@ class ENV(Enum):
     # block size decodes with the size carried in its own header.
     AUTODIST_QUANT_BLOCK = \
         (lambda v: _min_int('AUTODIST_QUANT_BLOCK', v, 256, lo=8),)
+    # Topology-aware hierarchical collectives: the number of node
+    # groups the data axis is split into for two-level schedules
+    # (intra-node reduce-scatter -> inter-node all-reduce -> intra-node
+    # all-gather, parallel/plan.py). 0 (default) = infer node groups
+    # from the mesh devices (process/slice index); >= 2 forces that
+    # many CONTIGUOUS equal groups — the CPU-mesh test/bench override.
+    # Forwarded to launched workers (coordinator _FORWARDED_FLAGS):
+    # the group layout is part of the traced program, and divergent
+    # HLO across SPMD hosts deadlocks.
+    AUTODIST_HIERARCHY_NODES = \
+        (lambda v: _min_int('AUTODIST_HIERARCHY_NODES', v, 0, lo=0),)
+    # Execute chief re-plans (elastic scale-up re-ranks) instead of
+    # only recording them: the session migrates its live state to the
+    # re-ranked strategy through the device-side resharding path
+    # (parallel/reshard.py) at the next step boundary. Default off —
+    # the PR 6 predicted-vs-kept audit trail is unchanged unless the
+    # operator opts in.
+    AUTODIST_EXECUTE_REPLAN = (lambda v: (v == 'True' or v == '1'),)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

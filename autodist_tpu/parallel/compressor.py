@@ -190,6 +190,88 @@ def int8_ring_all_reduce(x, axis_name, block=None):
     return full.reshape(-1)[:x.size].reshape(shape)
 
 
+def int8_grouped_ring_all_reduce(x, axis_name, groups, block=None):
+    """Block-quantized int8 ring all-reduce (sum) over INDEPENDENT
+    equal-size groups of axis positions.
+
+    Same wire recipe as :func:`int8_ring_all_reduce` (per-hop
+    requantization, per-block f32 scales), but the ring cycles run
+    within each group concurrently — the union of the per-group cycles
+    is one valid ppermute permutation, so all groups reduce in the
+    same ``k-1`` hops. This is the inter-node (DCN) phase of the
+    hierarchical schedule: ``groups`` then holds one same-chunk-rank
+    representative per node.
+    """
+    k = len(groups[0])
+    if k == 1:
+        return x
+    block = block or quant_block_size()
+    shape = x.shape
+    flat = jnp.ravel(x).astype(jnp.float32)
+    m = -(-flat.size // k)
+    flat = jnp.pad(flat, (0, m * k - flat.size))
+    chunks = flat.reshape(k, m)
+    n_axis = sum(len(g) for g in groups)
+    ranks = [0] * n_axis
+    for grp in groups:
+        for i, pos in enumerate(grp):
+            ranks[pos] = i
+    me = jnp.asarray(ranks)[jax.lax.axis_index(axis_name)]
+    perm = [(grp[i], grp[(i + 1) % k])
+            for grp in groups for i in range(k)]
+
+    cur = jax.lax.dynamic_index_in_dim(chunks, me, 0, keepdims=False)
+    for step in range(k - 1):
+        q, scales = _quantize_int8_blocks(cur, block)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        scales = jax.lax.ppermute(scales, axis_name, perm)
+        idx = (me - step - 1) % k
+        cur = _dequantize_int8_blocks(q, scales, m) + \
+            jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+
+    q, scales = _quantize_int8_blocks(cur, block)
+    all_q = jax.lax.all_gather(q, axis_name,
+                               axis_index_groups=groups)
+    all_s = jax.lax.all_gather(scales, axis_name,
+                               axis_index_groups=groups)
+    full = (all_q.astype(jnp.float32) *
+            all_s[:, :, None]).reshape(k, -1)[:, :m]
+    # group row j holds chunk (j+1)%k -> chunk c sits at row (c-1)%k
+    full = full[jnp.asarray([(c - 1) % k for c in range(k)])]
+    return full.reshape(-1)[:x.size].reshape(shape)
+
+
+def int8_hierarchical_all_reduce(x, axis_name, node_groups, block=None):
+    """Two-level int8-wire all-reduce (sum): quantize once, requantize
+    at the tier boundary.
+
+    The caller has already block-roundtripped the bucket once (the
+    "quantize once" of the error-feedback contract); the intra-node
+    phases then ride plain f32 grouped collectives on the cheap ICI
+    tier, and only the tier BOUNDARY requantizes: each node's partial
+    chunk sum rides the int8 ring across nodes (per-hop requant, the
+    DCN tier the quantization exists to relieve), and the reduced
+    chunks all-gather back within each node at f32.
+    """
+    k = len(node_groups)
+    g = len(node_groups[0])
+    if k <= 1 or g <= 1:
+        return int8_ring_all_reduce(x, axis_name, block=block)
+    shape = x.shape
+    flat = jnp.ravel(x).astype(jnp.float32)
+    m = -(-flat.size // g) * g
+    flat = jnp.pad(flat, (0, m - flat.size))
+    cur = jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                               tiled=True,
+                               axis_index_groups=node_groups)
+    inter = [[grp[r] for grp in node_groups] for r in range(g)]
+    cur = int8_grouped_ring_all_reduce(cur, axis_name, inter,
+                                       block=block)
+    out = jax.lax.all_gather(cur, axis_name, tiled=True,
+                             axis_index_groups=node_groups)
+    return out[:x.size].reshape(shape)
+
+
 def int8_bucket_fusable(compressor, dtype, size):
     """THE bucket-fusion predicate for the int8 tier, shared by
     ``plan.sync_gradients`` (runtime emission) and

@@ -74,6 +74,59 @@ def device_mesh_array(sizes, devices, dcn_dp=1):
     return np.array(devices).reshape(sizes)
 
 
+def data_axis_node_groups(mesh, forced_nodes=0):
+    """Node groups over the DATA axis for two-level collective
+    schedules: ``[[positions of node 0], [positions of node 1], ...]``
+    or None when the mesh is effectively single-node (flat stays the
+    emission — the degenerate case).
+
+    Grouping keys, in order of authority:
+
+    - ``forced_nodes >= 2`` (the ``AUTODIST_HIERARCHY_NODES``
+      override): that many CONTIGUOUS equal groups — how a virtual CPU
+      mesh or a dcn_dp layout (slice-major data axis) expresses its
+      node structure for tests and benches;
+    - real multi-slice TPU: the device's ``slice_index``;
+    - multi-host SPMD: the device's ``process_index``.
+
+    Groups must partition the axis into equal sizes >= 2 (the
+    two-level schedule needs a real intra phase and a real inter
+    phase); anything else returns None. Deterministic for a fixed
+    mesh, so every SPMD process traces the same group layout.
+    """
+    if AXIS_DATA not in mesh.axis_names:
+        return None
+    n = mesh.shape[AXIS_DATA]
+    if n <= 1:
+        return None
+    ax = list(mesh.axis_names).index(AXIS_DATA)
+    # one representative device per data-axis position (index 0 on
+    # every other axis)
+    arr = np.moveaxis(mesh.devices, ax, 0)
+    lane = arr.reshape(n, -1)[:, 0]
+    if forced_nodes and forced_nodes >= 2:
+        if n % forced_nodes or n // forced_nodes < 2:
+            logging.warning(
+                'AUTODIST_HIERARCHY_NODES=%d does not split the %d-way '
+                'data axis into equal groups of >= 2; hierarchical '
+                'emission stays flat', forced_nodes, n)
+            return None
+        g = n // forced_nodes
+        return [list(range(i * g, (i + 1) * g))
+                for i in range(forced_nodes)]
+    keys = [getattr(d, 'slice_index', None) for d in lane]
+    if any(k is None for k in keys):
+        keys = [getattr(d, 'process_index', 0) for d in lane]
+    groups = {}
+    for pos, key in enumerate(keys):
+        groups.setdefault(key, []).append(pos)
+    out = [groups[k] for k in sorted(groups)]
+    sizes = {len(g) for g in out}
+    if len(out) < 2 or len(sizes) != 1 or sizes == {1}:
+        return None
+    return out
+
+
 def build_mesh(num_replicas=None, axis_sizes=None, devices=None,
                dcn_dp=1):
     """Build the framework mesh.

@@ -76,6 +76,41 @@ def ring_all_reduce(x, axis_name):
     return full.reshape(-1)[:x.size].reshape(shape)
 
 
+def hierarchical_all_reduce(x, axis_name, node_groups):
+    """Two-level all-reduce (sum) over ``node_groups`` of axis
+    positions: intra-node reduce-scatter, inter-node all-reduce over
+    one chunk-owner per node, intra-node all-gather.
+
+    This is the PCCL-style process-group synthesis for a two-tier
+    (ICI within a node, DCN across nodes) topology: the only traffic
+    that crosses the node boundary is each node's ``1/g`` chunk of the
+    already-reduced bucket, so the DCN wire carries ``2(k-1)/k·B/g``
+    bytes instead of the flat ring's ``2(n-1)/n·B`` — the gap
+    :func:`~autodist_tpu.simulator.cost_model.hierarchical_time`
+    prices. Addition is associative over the regrouping, so the result
+    is the same sum the flat ring computes (bit-identical whenever the
+    per-element sums are exactly representable). Degenerate group
+    shapes (one node, or one device per node) collapse to a plain
+    ``psum``.
+    """
+    k = len(node_groups)
+    g = len(node_groups[0]) if node_groups else 0
+    if k <= 1 or g <= 1:
+        return jax.lax.psum(x, axis_name)
+    shape = x.shape
+    flat = jnp.ravel(x)
+    m = -(-flat.size // g) * g
+    flat = jnp.pad(flat, (0, m - flat.size))
+    cur = jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                               tiled=True,
+                               axis_index_groups=node_groups)
+    inter = [[grp[r] for grp in node_groups] for r in range(g)]
+    cur = jax.lax.psum(cur, axis_name, axis_index_groups=inter)
+    out = jax.lax.all_gather(cur, axis_name, tiled=True,
+                             axis_index_groups=node_groups)
+    return out[:x.size].reshape(shape)
+
+
 def bucket_bytes_cap(chunk_size=0):
     """Per-bucket byte cap for fused gradient collectives.
 
@@ -117,20 +152,25 @@ def pack_buckets(items, cap_bytes, max_vars=0):
 
 
 def static_collective_schedule(strategy, graph_item, num_replicas,
-                               sparse_lookups_per_replica=4096):
+                               sparse_lookups_per_replica=4096,
+                               nodes=1, params=None):
     """Static mirror of :meth:`ExecutionPlan.sync_gradients`'s emission.
 
     Computes, WITHOUT tracing a step, the per-step collective schedule a
     strategy lowers to on an ``num_replicas``-way data mesh: the same
     bucket packing (``pack_buckets`` under the chunk_size-derived byte
     cap, reverse production order), the same ZeRO ``psum_scatter``
-    chunking, and the param re-gather each sharded variable pays on the
-    next step. This is what the simulator's cost model prices.
+    chunking, the same per-bucket flat-vs-hierarchical decision
+    (``cost_model.choose_hierarchical`` over ``nodes`` node groups and
+    ``params``), and the param re-gather each sharded variable pays on
+    the next step. This is what the simulator's cost model prices.
 
     Entries match the ``last_bucket_stats`` schema plus a ``phase``
     field: ``{'kind', 'group', 'compressor', 'dtype', 'spec', 'vars',
-    'bytes', 'members', 'phase'}`` where ``phase`` is ``'grad'``
-    (gradient sync) or ``'param'`` (ZeRO param all-gather). ``bytes``
+    'bytes', 'members', 'phase', 'hier'}`` where ``phase`` is ``'grad'``
+    (gradient sync) or ``'param'`` (ZeRO param all-gather) and ``hier``
+    is the node-group count of a two-level all-reduce (0 = flat).
+    ``bytes``
     are RAW tensor bytes; anything REPORTING traffic must route them
     through ``simulator.cost_model.wire_bytes`` (as the cost model,
     ``profiling.bucket_report`` and ``bench.py`` do) — under a
@@ -145,11 +185,15 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     entries = []
     if n <= 1:
         return entries
-    nodes = {nd.var_name: nd for nd in strategy.node_config}
+    nodes = int(nodes or 1)
+    if nodes > 1 and params is None:
+        from autodist_tpu.simulator.cost_model import CostModelParams
+        params = CostModelParams()
+    node_cfg = {nd.var_name: nd for nd in strategy.node_config}
     sources = list(graph_item.trainable_var_op_to_var.values())
     plans = []
     for var in sources:
-        node = nodes.get(var.name)
+        node = node_cfg.get(var.name)
         if node is None:
             from autodist_tpu.strategy.base import StrategyNode
             node = StrategyNode(var_name=var.name,
@@ -170,9 +214,9 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
         return {'kind': kind, 'group': group, 'compressor': compressor,
                 'dtype': str(np.dtype(plan.var.dtype)), 'spec': plan.spec,
                 'vars': vars_, 'bytes': int(nbytes), 'members': members,
-                'phase': phase}
+                'phase': phase, 'hier': 0}
 
-    fusable = {}   # (group, compressor cls name, dtype, spec) -> [idx]
+    fusable = {}   # (group, compressor name, dtype, spec, hier) -> [idx]
     for i, (var, plan) in enumerate(zip(sources, plans)):
         itemsize = np.dtype(var.dtype).itemsize
         size = int(np.prod(var.shape or (1,)))
@@ -233,7 +277,8 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                                            comp.HorovodCompressor) or
                  comp.int8_bucket_fusable(plan.compressor, var.dtype,
                                           size)):
-            key = (plan.group, cname, str(np.dtype(var.dtype)), plan.spec)
+            key = (plan.group, cname, str(np.dtype(var.dtype)),
+                   plan.spec, plan.hierarchical)
             fusable.setdefault(key, []).append(i)
         else:
             entries.append(entry('all_reduce', plan, nbytes, [var.name],
@@ -241,7 +286,7 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     # pack fusable groups exactly like sync_gradients: byte-capped
     # buckets in reverse production order, emitted tail-first
     pending = []
-    for (group, cname, dtype, spec), idxs in fusable.items():
+    for (group, cname, dtype, spec, hknob), idxs in fusable.items():
         chunk = max(plans[i].chunk_size for i in idxs)
         cap = bucket_bytes_cap(chunk)
         items = [(i, int(np.prod(sources[i].shape or (1,))) *
@@ -250,15 +295,24 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
         sizes = dict(items)
         for bucket in pack_buckets(items, cap,
                                    chunk or DEFAULT_CHUNK_SIZE):
-            pending.append((bucket, sizes, group, cname, dtype, spec))
+            pending.append((bucket, sizes, group, cname, dtype, spec,
+                            hknob))
     pending.sort(key=lambda b: -max(b[0]))
-    for bucket, sizes, group, cname, dtype, spec in pending:
+    for bucket, sizes, group, cname, dtype, spec, hknob in pending:
+        nbytes = sum(sizes[i] for i in bucket)
+        hier = 0
+        if nodes > 1:
+            from autodist_tpu.simulator.cost_model import \
+                choose_hierarchical
+            if choose_hierarchical(nbytes, dtype, cname, n, nodes,
+                                   params, knob=hknob, spec=spec):
+                hier = nodes
         entries.append({
             'kind': 'all_reduce', 'group': group, 'compressor': cname,
             'dtype': dtype, 'spec': spec, 'vars': len(bucket),
-            'bytes': sum(sizes[i] for i in bucket),
+            'bytes': nbytes,
             'members': [sources[i].name for i in bucket],
-            'phase': 'grad'})
+            'phase': 'grad', 'hier': hier})
     return entries
 
 
@@ -313,11 +367,14 @@ class VarPlan:
             self.group = self.sync.group
             self.spec = self.sync.spec
             self.chunk_size = getattr(self.sync, 'chunk_size', 0)
+            self.hierarchical = getattr(self.sync, 'hierarchical',
+                                        'auto') or 'auto'
         else:
             self.compressor = comp.create('NoneCompressor', var.name)
             self.group = None
             self.spec = 'AUTO'
             self.chunk_size = 0
+            self.hierarchical = 'never'
         # ZeRO-style state sharding applies to partitioned vars; when the
         # partition axis does not divide the mesh data axis (the uneven
         # case, UnevenPartitionedPS) the physical state is zero-padded to
@@ -333,11 +390,24 @@ class ExecutionPlan:
     """Binds (strategy, graph_item, mesh) into callable sync/sharding hooks."""
 
     def __init__(self, strategy, graph_item, mesh, shard_ps_state=True,
-                 loose=False):
+                 loose=False, topology=None):
         self.strategy = strategy
         self.graph_item = graph_item
         self.mesh = mesh
         self.num_replicas = mesh.shape[AXIS_DATA]
+        # two-level collective context: the data axis's node groups
+        # (None = single-node mesh, flat emission — the degenerate
+        # case) and the α-β constants the per-bucket flat-vs-
+        # hierarchical decision prices with. ``topology`` is the
+        # resource spec's validated Topology when the caller has one;
+        # without it the analytic defaults apply.
+        from autodist_tpu.parallel.mesh import data_axis_node_groups
+        self.topology = topology
+        self.hier_groups = data_axis_node_groups(
+            mesh, forced_nodes=ENV.AUTODIST_HIERARCHY_NODES.val)
+        from autodist_tpu.simulator.cost_model import CostModelParams
+        self.cost_params = CostModelParams.from_topology(topology) \
+            if topology is not None else CostModelParams()
         # loose mode: independent per-process programs + coord-service PS
         # (relaxed-consistency strategies); mesh is process-local.
         self.loose = loose
@@ -404,11 +474,29 @@ class ExecutionPlan:
         return self.var_plans[name]
 
     # -- gradient synchronization (runs inside shard_map) -----------------
-    def _reduce_fn(self, spec):
+    def _reduce_fn(self, spec, hier_groups=None):
+        n = self.num_replicas
+        if hier_groups:
+            return lambda g: hierarchical_all_reduce(
+                g, AXIS_DATA, hier_groups) / n
         if spec == 'RING':
-            n = self.num_replicas
             return lambda g: ring_all_reduce(g, AXIS_DATA) / n
         return lambda g: jax.lax.pmean(g, AXIS_DATA)
+
+    def _hier_groups_for(self, nbytes, dtype, compressor_name, spec,
+                         knob):
+        """Node groups for ONE bucket's collective, or None for flat —
+        the trace-time side of the SHARED cost-model decision
+        (``cost_model.choose_hierarchical``), so the traced emission
+        and ``static_collective_schedule`` can never drift."""
+        groups = self.hier_groups
+        if not groups:
+            return None
+        from autodist_tpu.simulator.cost_model import choose_hierarchical
+        ok = choose_hierarchical(nbytes, dtype, compressor_name,
+                                 self.num_replicas, len(groups),
+                                 self.cost_params, knob=knob, spec=spec)
+        return groups if ok else None
 
     # -- sparse (IndexedSlices-equivalent) gradient sync ------------------
     def _purely_sparse(self, var):
@@ -605,16 +693,20 @@ class ExecutionPlan:
                      comp.int8_bucket_fusable(plan.compressor,
                                               grad.dtype, grad.size))):
                 key = (plan.group, type(plan.compressor).__name__,
-                       str(grad.dtype), plan.spec)
+                       str(grad.dtype), plan.spec, plan.hierarchical)
                 fusable.setdefault(key, []).append(i)
             else:
                 out[i] = plan.compressor.reduce(
                     grad, env, self._reduce_fn(plan.spec))
         # Pack every fusable group into byte-capped buckets, then emit
         # ALL buckets (across groups) ordered by reverse production:
-        # the bucket holding the highest variable indices first.
-        pending = []   # (bucket idx list, group, cname, dtype, spec)
-        for (group, cname, dtype, spec), idxs in fusable.items():
+        # the bucket holding the highest variable indices first. Each
+        # bucket independently picks flat vs two-level: on a multi-node
+        # mesh the shared cost-model decision can send a large
+        # DCN-bound bucket down the hierarchical schedule while small
+        # buckets keep the flat ring.
+        pending = []   # (bucket idxs, group, cname, dtype, spec, hknob)
+        for (group, cname, dtype, spec, hknob), idxs in fusable.items():
             chunk = max(self.plan_for(sources[i]).chunk_size
                         for i in idxs)
             cap = bucket_bytes_cap(chunk)
@@ -623,18 +715,22 @@ class ExecutionPlan:
                      for i in reversed(idxs)]
             for bucket in pack_buckets(items, cap,
                                        chunk or DEFAULT_CHUNK_SIZE):
-                pending.append((bucket, group, cname, dtype, spec))
+                pending.append((bucket, group, cname, dtype, spec,
+                                hknob))
         pending.sort(key=lambda b: -max(b[0]))
-        for bucket, group, cname, dtype, spec in pending:
+        for bucket, group, cname, dtype, spec, hknob in pending:
             nbytes = sum(int(grads[i].size *
                              jnp.dtype(grads[i].dtype).itemsize)
                          for i in bucket)
+            groups = self._hier_groups_for(nbytes, dtype, cname, spec,
+                                           hknob)
             self.last_bucket_stats.append({
                 'kind': 'all_reduce', 'group': group,
                 'compressor': cname, 'dtype': dtype, 'spec': spec,
                 'vars': len(bucket), 'bytes': nbytes,
-                'members': [sources[i].name for i in bucket]})
-            if len(bucket) == 1:
+                'members': [sources[i].name for i in bucket],
+                'hier': len(groups) if groups else 0})
+            if len(bucket) == 1 and groups is None:
                 i = bucket[0]
                 plan = self.plan_for(sources[i])
                 out[i] = plan.compressor.reduce(
@@ -644,15 +740,17 @@ class ExecutionPlan:
             sizes = [f.shape[0] for f in flats]
             if cname == 'Int8RingCompressor':
                 buf = self._int8_bucket_reduce(bucket, sources, flats,
-                                               env)
+                                               env, hier_groups=groups)
             else:
+                reduce_fn = self._reduce_fn(spec, hier_groups=groups) \
+                    if groups else self._reduce_fn(spec)
                 buf = jnp.concatenate(flats)
                 if cname == 'HorovodCompressor' and \
                         buf.dtype == jnp.float32:
-                    buf = self._reduce_fn(spec)(
+                    buf = reduce_fn(
                         buf.astype(jnp.bfloat16)).astype(jnp.float32)
                 else:
-                    buf = self._reduce_fn(spec)(buf)
+                    buf = reduce_fn(buf)
             offset = 0
             for i, size in zip(bucket, sizes):
                 out[i] = buf[offset:offset + size].reshape(
@@ -660,7 +758,8 @@ class ExecutionPlan:
                 offset += size
         return out
 
-    def _int8_bucket_reduce(self, bucket, sources, flats, env):
+    def _int8_bucket_reduce(self, bucket, sources, flats, env,
+                            hier_groups=None):
         """Quantized-collective reduction of ONE packed bucket.
 
         The whole bucket is quantized as a single vector with per-block
@@ -700,6 +799,12 @@ class ExecutionPlan:
                 ).reshape(self.plan_for(sources[i]).var.shape)}
             offset += size
         n = self.num_replicas
+        if hier_groups:
+            # quantize once (the roundtrip above), requantize at the
+            # tier boundary: intra-node phases ride f32 ICI, only the
+            # cross-node chunk rides the int8 ring
+            return comp.int8_hierarchical_all_reduce(
+                transmitted, AXIS_DATA, hier_groups) / n
         return comp.int8_ring_all_reduce(transmitted, AXIS_DATA) / n
 
     # -- padded physical layout (uneven partitions) ------------------------

@@ -123,6 +123,19 @@ class Topology:
         self.dcn_latency_us = float(
             info.get('dcn_latency_us', _DCN_DEFAULT_LATENCY_US))
         self.multi_node = bool(multi_node)
+        # Re-validate the RESOLVED link constants, not just the raw
+        # fields: the simulator divides by link() bandwidth with no
+        # guard (CostModelParams.from_topology), and the per-field
+        # check above admits NaN (NaN <= 0 is False) while defaulted
+        # values come from arithmetic on per-node bandwidths. Fail at
+        # parse time with the field named, like the hint validation.
+        import math
+        for field in self._NUMERIC_FIELDS:
+            val = getattr(self, field)
+            if not math.isfinite(val) or val <= 0:
+                raise ValueError(
+                    'topology.%s must resolve to a positive finite '
+                    'number, got %r' % (field, val))
 
     def link(self, cross_node=False):
         """(bytes/s, seconds) for one link class.

@@ -32,6 +32,9 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # program (compressor) AND the PS frame format
                     ENV.AUTODIST_QUANT_BLOCK,
                     ENV.AUTODIST_S2D_STEM, ENV.AUTODIST_DENSENET_DUS,
+                    # hierarchical node-group layout is part of the
+                    # traced program (two-level collective schedules)
+                    ENV.AUTODIST_HIERARCHY_NODES,
                     # bucket layout + overlap flags must agree on every
                     # traced host — divergent HLO across SPMD deadlocks
                     ENV.AUTODIST_BUCKET_BYTES, ENV.AUTODIST_XLA_OVERLAP,

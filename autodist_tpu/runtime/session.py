@@ -463,6 +463,12 @@ class Session:
         # with the main thread.
         import threading
         self._stats_lock = threading.Lock()
+        # executed re-plans (AUTODIST_EXECUTE_REPLAN): the background
+        # re-rank thread STAGES a migration here; run() applies it at
+        # the next step boundary (the only safe point — mid-step state
+        # is half old-layout, half new).
+        self._replan_lock = threading.Lock()
+        self._pending_replan = None
         self._pipe = None
         self._inflight = None
         self._stashed_prefetch = None
@@ -745,12 +751,15 @@ class Session:
     def _replan_for_world(self, world):
         """On admit, re-rank strategies for the NEW world size with the
         simulator (``AutoStrategy`` over the grown replica count) and
-        record the predicted-vs-kept decision. Execution KEEPS the
-        current plan: moving live state between strategy layouts needs
-        the device-side resharding path (ROADMAP item 3), so this is
-        the audit trail that migration would have paid off — never a
-        behavior change, and never fatal (a re-rank failure must not
-        take down the training it advises)."""
+        record the predicted-vs-kept decision. By default execution
+        KEEPS the current plan and this is pure audit trail; with
+        ``AUTODIST_EXECUTE_REPLAN`` set, a migratable re-plan (the PS
+        family, preserving the current relaxed-consistency flags so
+        loose mode stays loose) is additionally STAGED here and applied
+        by ``run()`` at the next step boundary through the device-side
+        resharding path (:mod:`autodist_tpu.parallel.reshard`). Never
+        fatal either way — a re-rank failure must not take down the
+        training it advises."""
         entry = {'world': world,
                  'kept': dict(getattr(self._plan.strategy, 'cost', None)
                               or {}).get('builder', ''),
@@ -774,18 +783,284 @@ class Session:
                      if c.name == entry['kept'] and c.report is not None),
                     None)
                 entry['kept_predicted_step_time_s'] = kept_rank
+                execute = ENV.AUTODIST_EXECUTE_REPLAN.val and self._loose
                 logging.info(
                     're-ranked strategies for world=%d: predicted best '
-                    '%s (%.4gs/step), kept %s — live migration needs '
-                    'the resharding path (ROADMAP item 3)', world,
+                    '%s (%.4gs/step), kept %s%s', world,
                     entry['predicted'],
                     entry['predicted_step_time_s'] or float('nan'),
-                    entry['kept'] or '(hand-picked)')
+                    entry['kept'] or '(hand-picked)',
+                    ' — staging migration through the reshard path'
+                    if execute else
+                    ' (AUTODIST_EXECUTE_REPLAN off: audit only)')
+                if execute:
+                    mig = self._build_migratable_strategy(world, rs)
+                    if mig is None:
+                        entry['migration_skipped'] = \
+                            'no PS-family candidate for this strategy'
+                    else:
+                        entry['migration_staged'] = dict(
+                            getattr(mig, 'cost', None) or {}) \
+                            .get('builder', '')
+                        with self._replan_lock:
+                            self._pending_replan = {
+                                'strategy': mig, 'world': world,
+                                'entry': entry}
         except Exception as e:  # noqa: BLE001 - advisory, never fatal
             entry['error'] = '%s: %s' % (type(e).__name__, e)
             logging.warning('strategy re-rank for world=%d failed: %s',
                             world, entry['error'])
         self._health['replans'].append(entry)
+
+    def _build_migratable_strategy(self, world, rs):
+        """Best strategy this LIVE session can actually migrate to: the
+        PS family with the current strategy's relaxed-consistency flags
+        preserved (sync / staleness / shared_optimizer / proxy), so the
+        re-plan stays a loose-mode strategy — switching execution MODE
+        (loose <-> SPMD) live would need a new runtime, not a reshard —
+        AND with the current DATA-PLANE GEOMETRY preserved (same shard
+        key layout per variable): live peers keep pulling/pushing the
+        old keys until cohort-wide strategy propagation exists
+        (ROADMAP 3a), so a chief-local migration that re-keyed shards
+        would fork the model between chief and peers. Returns None
+        when the current strategy carries no PS sync to clone flags
+        from, or no geometry-compatible candidate ranks."""
+        from autodist_tpu.simulator import search
+        from autodist_tpu.strategy import builders as b
+        from autodist_tpu.strategy.base import PSSynchronizer
+        flags = None
+        for node in self._plan.strategy.node_config:
+            for sync in [node.synchronizer] + list(node.part_config):
+                if isinstance(sync, PSSynchronizer):
+                    flags = {'sync': sync.sync,
+                             'staleness': sync.staleness,
+                             'shared_optimizer': sync.shared_optimizer,
+                             'local_proxy_variable':
+                                 sync.local_replication}
+                    break
+            if flags is not None:
+                break
+        if flags is None:
+            return None
+        cands = [
+            ('PS', lambda: b.PS(**flags)),
+            ('PSLoadBalancing', lambda: b.PSLoadBalancing(**flags)),
+            ('PartitionedPS', lambda: b.PartitionedPS(**flags)),
+            ('UnevenPartitionedPS',
+             lambda: b.UnevenPartitionedPS(**flags)),
+        ]
+        feasible, _ = search.rank(
+            self._graph_item, rs, candidates=cands,
+            num_replicas=world * max(1, self._plan.local_replicas))
+        names = list(self._graph_item.graph.variables)
+        for cand in feasible:
+            shards = {n.var_name: n.num_shards
+                      for n in cand.strategy.node_config}
+            if all(self._ps_geometry(self._plan, name) ==
+                   (['var/%s/shard%d' % (name, i)
+                     for i in range(shards.get(name, 1))]
+                    if shards.get(name, 1) > 1 else ['var/%s' % name])
+                   for name in names):
+                return cand.strategy
+        logging.info(
+            'executed re-plan: no geometry-compatible PS-family '
+            'candidate for world=%d (cohort-wide re-keying needs '
+            'ROADMAP 3a); keeping the current plan', world)
+        return None
+
+    def _apply_pending_replan(self):
+        with self._replan_lock:
+            pending, self._pending_replan = self._pending_replan, None
+        if pending is not None:
+            self._execute_replan(**pending)
+
+    @staticmethod
+    def _ps_geometry(plan, name):
+        """Data-plane key layout for one variable under ``plan`` (the
+        pure-plan form of :meth:`_shard_info`'s key list)."""
+        p = plan.var_plans.get(name)
+        nshards = getattr(p, 'num_shards', 1) if p is not None else 1
+        if nshards > 1:
+            return ['var/%s/shard%d' % (name, i) for i in range(nshards)]
+        return ['var/%s' % name]
+
+    def _execute_replan(self, strategy, world, entry):
+        """Migrate this session's live state to a re-ranked strategy —
+        the execution half of the elastic re-plan (ROADMAP item 3's
+        resharding unlock). At a step boundary, atomically:
+
+        1. build the new :class:`ExecutionPlan` over the SAME mesh;
+        2. move ``_var_state`` (and every optimizer slot shaped like
+           its variable) old-layout -> new-layout ON DEVICE through
+           :mod:`autodist_tpu.parallel.reshard` — values are moved,
+           never recomputed, so the migration is bit-exact;
+        3. re-init compressor aux state whose contract changed
+           (carrying entries whose compressor kept shape+keys);
+        4. swap the plan and drop compiled steps.
+
+        The shared data plane is deliberately UNTOUCHED: a migration
+        that would change any variable's shard-key geometry or move it
+        between PS endpoints is REFUSED (recorded as
+        ``migration_skipped``) — live peers keep using the old keys
+        until cohort-wide strategy propagation exists (ROADMAP 3a), so
+        ``_build_migratable_strategy`` only stages geometry-compatible
+        candidates and this method re-checks.
+
+        Never fatal: everything fallible runs BEFORE the swap and the
+        new state is built entirely on the side, so any failure keeps
+        the old plan + state untouched and records the error on the
+        replan audit entry.
+        """
+        import time as _time
+        t0 = _time.perf_counter()
+        old_plan = self._plan
+        try:
+            from autodist_tpu.parallel import reshard as reshard_mod
+            from autodist_tpu.parallel.plan import ExecutionPlan
+            from autodist_tpu.strategy.base import StrategyCompiler
+            compiled = StrategyCompiler(self._graph_item).prune(strategy)
+            new_plan = ExecutionPlan(
+                compiled, self._graph_item, self._mesh,
+                loose=self._loose, topology=old_plan.topology)
+            # a mid-flight background push/pull rides the OLD plan's
+            # placement: join it first, discard its prefetch
+            if self._pipe is not None:
+                pre = self._join_pipeline()
+                if pre is not None:
+                    self._account_prefetch_discard(pre)
+            variables = list(self._graph_item.graph.variables)
+            # belt-and-braces: _build_migratable_strategy only stages
+            # geometry-compatible strategies, but a re-keying migration
+            # must NEVER execute — live peers keep using the old keys
+            # (cohort-wide propagation is ROADMAP 3a)
+            moved_geom = [
+                name for name in variables
+                if self._ps_geometry(old_plan, name) !=
+                self._ps_geometry(new_plan, name)] if self._loose else []
+            if moved_geom:
+                entry['migration_skipped'] = (
+                    'shard geometry changes for %s — re-keying a live '
+                    'data plane needs cohort-wide propagation'
+                    % sorted(moved_geom)[:4])
+                logging.warning(
+                    'executed re-plan for world=%d refused: %s', world,
+                    entry['migration_skipped'])
+                return
+            # device-side layout moves: vars + matching optimizer slots
+            ops = reshard_mod.plan_reshard(old_plan, new_plan)
+            fns = {op.var_name:
+                   reshard_mod.reshard_fn(op, old_plan, new_plan)
+                   for op in ops}
+            new_vars = {
+                name: fns[name](arr) if name in fns else arr
+                for name, arr in self._var_state.items()}
+            new_opt = {}
+            for uid, by_var in self._opt_state.items():
+                new_by_var = {}
+                for vname, leafstate in by_var.items():
+                    fn = fns.get(vname)
+                    phys = old_plan.padded_shape(vname)
+
+                    def move(leaf, fn=fn, phys=phys):
+                        if fn is not None and phys is not None and \
+                                hasattr(leaf, 'shape') and \
+                                tuple(leaf.shape) == tuple(phys):
+                            return fn(leaf)
+                        return leaf
+                    new_by_var[vname] = jax.tree.map(move, leafstate)
+                new_opt[uid] = new_by_var
+            # compressor aux state: carry entries whose contract
+            # (keys + per-replica shapes) is unchanged, re-init the
+            # rest — at worst one step of error feedback resets, the
+            # same bound as a worker restart
+            n = new_plan.num_replicas
+            rep_sharding = NamedSharding(self._mesh, P(AXIS_DATA))
+            new_aux = {}
+            for name, vplan in new_plan.var_plans.items():
+                aux = vplan.compressor.init_state(
+                    np.asarray(vplan.var.init_value))
+                if not aux:
+                    continue
+                key = 'compressor/%s' % name
+                old = self._aux_state.get(key)
+                if old is not None and set(old) == set(aux) and all(
+                        tuple(old[k].shape[1:]) == tuple(v.shape)
+                        for k, v in aux.items()):
+                    new_aux[key] = old
+                else:
+                    new_aux[key] = {
+                        k: self._put(
+                            jnp.broadcast_to(jnp.asarray(v),
+                                             (n,) + tuple(v.shape)),
+                            rep_sharding)
+                        for k, v in aux.items()}
+            # new endpoint placement is computed on the side too, and
+            # an index that MOVES any live variable between endpoints
+            # aborts like a geometry change would (peers keep dialing
+            # the old endpoints)
+            new_ps_index = self._ps_index
+            if self._loose:
+                from autodist_tpu.runtime import coord_client as cc
+                eps = cc.ps_endpoints()
+                if eps:
+                    new_ps_index = assign_ps_endpoints(
+                        new_plan.var_plans, eps)
+                    moved_eps = [
+                        name for name in variables
+                        if self._ps_index.get(name) is not None
+                        and new_ps_index.get(name) !=
+                        self._ps_index.get(name)]
+                    if moved_eps:
+                        entry['migration_skipped'] = (
+                            'endpoint placement moves for %s — '
+                            'needs cohort-wide propagation'
+                            % sorted(moved_eps)[:4])
+                        logging.warning(
+                            'executed re-plan for world=%d refused: '
+                            '%s', world, entry['migration_skipped'])
+                        return
+            # ---- swap (everything above built on the side) ----
+            self._plan = new_plan
+            self._var_state = new_vars
+            self._opt_state = new_opt
+            self._aux_state = new_aux
+            self._cache.clear()
+            self._proxy_cache = {}
+            self._proxy_vars = {
+                name for name, p in new_plan.var_plans.items()
+                if p.is_ps and any(getattr(s, 'local_replication', False)
+                                   for s in p.all_syncs)}
+            self._shared_opt_vars = {
+                name for name, p in new_plan.var_plans.items()
+                if p.is_ps and any(getattr(s, 'shared_optimizer', False)
+                                   for s in p.all_syncs)}
+            self._sparse_vars = {
+                name for name, p in new_plan.var_plans.items()
+                if p.is_ps and getattr(p.var, 'sparse_read', False)
+                and len(p.var.shape) == 2
+                and (p.num_shards <= 1 or p.partition_axis == 0)}
+            self._ps_index = new_ps_index
+            entry['migrated'] = True
+            entry['migration'] = {
+                'world': world,
+                'builder': dict(getattr(strategy, 'cost', None)
+                                or {}).get('builder', ''),
+                'strategy_id': compiled.id,
+                'reshard': reshard_mod.summarize(ops),
+                'wall_s': round(_time.perf_counter() - t0, 4)}
+            logging.info(
+                'executed re-plan for world=%d: migrated to %s in '
+                '%.3fs (%s); compiled steps dropped, state moved '
+                'device-side', world,
+                entry['migration']['builder'] or compiled.id,
+                entry['migration']['wall_s'],
+                entry['migration']['reshard'])
+        except Exception as e:  # noqa: BLE001 - keep the old plan
+            entry['migration_error'] = '%s: %s' % (type(e).__name__, e)
+            self._plan = old_plan
+            logging.warning(
+                'executed re-plan for world=%d failed (%s); keeping '
+                'the current plan', world, entry['migration_error'])
 
     def _exclude_peer(self, wkey, timeout):
         """Epoch-fenced exclusion of a dead peer. Every detector fences
@@ -1293,6 +1568,10 @@ class Session:
                 'Graph modified after distributed session creation '
                 '(%d nodes, built with %d)' %
                 (self._user_node_count(), self._built_node_count))
+        # staged executed re-plan (AUTODIST_EXECUTE_REPLAN): apply at
+        # the step boundary, before anything touches the plan
+        if self._pending_replan is not None:
+            self._apply_pending_replan()
         feed_dict = feed_dict or {}
         single = not isinstance(fetches, (list, tuple))
         fetch_list = [fetches] if single else list(fetches)

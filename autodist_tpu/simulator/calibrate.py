@@ -27,6 +27,8 @@ _SHAPE_RE = re.compile(r'(\w+)\[([\d,]*)\]')
 _KIND_RE = re.compile(
     r'(all-reduce|all-gather|reduce-scatter|collective-permute|'
     r'all-to-all)(-start|-done)?\(')
+_GROUPS_RE = re.compile(
+    r'replica_groups=\{(\{[^{}]*\}(?:,\{[^{}]*\})*)\}')
 
 
 def _result_bytes_and_kind(op_text):
@@ -55,6 +57,21 @@ def _result_bytes_and_kind(op_text):
     return total, m.group(1)
 
 
+def _replica_groups(op_text):
+    """Parsed ``replica_groups={{0,1},{2,3}}`` of one HLO instruction,
+    or None for the global (empty / absent) group — flat collectives
+    over the whole mesh carry ``replica_groups={}``."""
+    m = _GROUPS_RE.search(op_text)
+    if not m:
+        return None
+    groups = []
+    for grp in re.findall(r'\{([^{}]*)\}', m.group(1)):
+        ids = [int(x) for x in grp.split(',') if x.strip()]
+        if ids:
+            groups.append(ids)
+    return groups or None
+
+
 def samples_from_timeline(timeline):
     """``[(wire_bytes, kind, seconds_per_occurrence)]`` from timeline
     rows (``-start`` async halves dropped — see
@@ -66,6 +83,42 @@ def samples_from_timeline(timeline):
             continue
         samples.append((bk[0], bk[1], ns / 1e9 / cnt))
     return samples
+
+
+def tiered_samples_from_timeline(timeline, devices_per_node):
+    """Split timeline rows by LINK CLASS for per-tier calibration.
+
+    A hierarchical schedule's timeline mixes collectives on two
+    physically different links: the intra-node phases run over groups
+    that stay within one node, the inter-node phase over groups that
+    span nodes. Fitting one α-β through both mispriced exactly the
+    flat-vs-hierarchical ranking calibration exists to sharpen, so
+    each row is classified by its HLO ``replica_groups``: every group
+    within one node (``id // devices_per_node`` constant) -> ICI; any
+    cross-node group — including the global ``{}`` group a flat
+    collective carries, which spans nodes by construction on a
+    multi-node run — -> DCN.
+
+    Returns ``(ici, dcn)`` sample lists; each sample is
+    ``(wire_bytes, kind, seconds, group_size)`` with the group size
+    the fit's hop count must use (an intra-node ring has ``g-1`` hops,
+    not ``n-1``).
+    """
+    g = max(1, int(devices_per_node))
+    ici, dcn = [], []
+    for name, ns, cnt in timeline:
+        bk = _result_bytes_and_kind(name)
+        if bk is None or not cnt or ns <= 0:
+            continue
+        t = ns / 1e9 / cnt
+        groups = _replica_groups(name)
+        if groups is None:
+            dcn.append((bk[0], bk[1], t, 0))
+            continue
+        cross = any(len({i // g for i in grp}) > 1 for grp in groups)
+        size = len(groups[0])
+        (dcn if cross else ici).append((bk[0], bk[1], t, size))
+    return ici, dcn
 
 
 #: (hop multiplier, byte multiplier as a fraction of (n-1)/n·B) per
@@ -88,7 +141,10 @@ def fit_alpha_beta(samples, num_replicas):
     Each sample contributes ``t ≈ h(kind)·α + w(kind)·B·β`` with the
     hop/byte multipliers of ITS collective kind — so reduce-scatter/
     all-gather rows (a ZeRO run's whole timeline) are not mispriced
-    through the ring-all-reduce formula. Returns ``(alpha_s,
+    through the ring-all-reduce formula. A sample may carry a fourth
+    element, its own replica-GROUP size (hierarchical schedules run
+    intra-node collectives over ``g`` devices, not ``n``); 0 or absent
+    falls back to ``num_replicas``. Returns ``(alpha_s,
     beta_s_per_byte)`` or None when the fit is degenerate (fewer than
     2 distinct byte sizes, or a non-positive β — measurement noise on
     tiny collectives).
@@ -97,8 +153,10 @@ def fit_alpha_beta(samples, num_replicas):
 
     n = max(2, int(num_replicas))
     rows = []
-    for b, kind, t in samples:
-        f = _kind_factors(kind, n)
+    for s in samples:
+        b, kind, t = s[0], s[1], s[2]
+        n_s = int(s[3]) if len(s) > 3 and s[3] else n
+        f = _kind_factors(kind, max(2, n_s))
         if f is None:
             continue
         rows.append((f[0], f[1] * b, t))
@@ -113,22 +171,86 @@ def fit_alpha_beta(samples, num_replicas):
 
 
 def calibrate_from_timeline(params, timeline, num_replicas,
-                            cross_node=False):
+                            cross_node=False, devices_per_node=0):
     """Refined copy of ``params`` from collective timeline rows.
 
+    With ``devices_per_node > 1`` (a multi-node run whose node shape
+    the caller knows), the ICI and DCN tiers are fitted SEPARATELY:
+    rows are split by replica-group span
+    (:func:`tiered_samples_from_timeline`) and each tier gets its own
+    least-squares α-β, so the flat-vs-hierarchical ranking is
+    calibrated per link class. A tier with too few samples for its own
+    fit falls back to the SHARED fit over all rows (the pre-tier
+    behavior); when that is degenerate too, the analytic constants for
+    that tier stay in place.
+
+    Without ``devices_per_node``, the single shared fit lands on the
+    tier ``cross_node`` selects, exactly as before.
+
     Leaves ``params`` untouched (and returns it as-is, warned) when the
-    timeline yields no usable fit.
+    timeline yields no usable fit at all.
     """
+    import dataclasses
+
     samples = samples_from_timeline(timeline or [])
-    fit = fit_alpha_beta(samples, num_replicas) if samples else None
-    if fit is None:
+    shared = fit_alpha_beta(samples, num_replicas) if samples else None
+    if devices_per_node and devices_per_node > 1:
+        ici, dcn = tiered_samples_from_timeline(timeline or [],
+                                                devices_per_node)
+        fit_i = fit_alpha_beta(ici, devices_per_node) if ici else None
+        fit_d = fit_alpha_beta(dcn, num_replicas) if dcn else None
+        # the tier fallback inverts through each row's OWN group size
+        # (a group-aware shared fit), not the legacy flat-n assumption
+        shared = fit_alpha_beta(ici + dcn, num_replicas) or shared \
+            if (ici or dcn) else shared
+        out = params
+        for tier, fit, nrows in (('ICI', fit_i, len(ici)),
+                                 ('DCN', fit_d, len(dcn))):
+            if fit is None:
+                # a tier with SOME rows but a degenerate fit borrows
+                # the group-aware shared fit (its own rows are in it);
+                # a tier ABSENT from the trace keeps its analytic
+                # constants — assigning an all-DCN shared fit to an
+                # unmeasured ICI tier would make the model reject
+                # every two-level schedule, the opposite of what
+                # calibration is for
+                if nrows == 0 or shared is None:
+                    logging.info(
+                        'calibrate: %s tier has no usable fit (%d '
+                        'rows%s) — keeping its analytic constants',
+                        tier, nrows,
+                        '' if nrows else ', tier absent from trace')
+                    continue
+                logging.info(
+                    'calibrate: %s tier has too few samples (%d '
+                    'rows); falling back to the shared fit', tier,
+                    nrows)
+                fit = shared
+            alpha, beta = fit
+            if tier == 'DCN':
+                out = dataclasses.replace(
+                    out, alpha_dcn_s=alpha, beta_dcn_s_per_byte=beta,
+                    calibrated=True)
+            else:
+                out = dataclasses.replace(
+                    out, alpha_ici_s=alpha, beta_ici_s_per_byte=beta,
+                    calibrated=True)
+            logging.info(
+                'calibrate: fitted %s tier alpha=%.3gs beta=%.3gs/B '
+                '(%d rows)', tier, alpha, beta, nrows)
+        if not out.calibrated:
+            logging.warning(
+                'calibrate: no usable collective samples in either '
+                'tier (%d rows) — keeping analytic α-β constants',
+                len(timeline or []))
+        return out
+    if shared is None:
         logging.warning(
             'calibrate: no usable collective samples (%d rows, %d '
             'parsed) — keeping analytic α-β constants', len(timeline or []),
             len(samples))
         return params
-    alpha, beta = fit
-    import dataclasses
+    alpha, beta = shared
     if cross_node:
         out = dataclasses.replace(params, alpha_dcn_s=alpha,
                                   beta_dcn_s_per_byte=beta,
@@ -144,13 +266,16 @@ def calibrate_from_timeline(params, timeline, num_replicas,
 
 
 def calibrate_from_trace(params, trace_dir, num_replicas,
-                         cross_node=False, line_name='XLA Ops'):
+                         cross_node=False, line_name='XLA Ops',
+                         devices_per_node=0):
     """Refined copy of ``params`` from a captured profiler trace dir
     (``Trainer.profile`` / ``RunOptions`` output). Degrades to the
     analytic constants when the trace has no collective rows (e.g.
     CPU-fallback runs, where profiling.collective_timeline itself
-    degrades to empty)."""
+    degrades to empty). ``devices_per_node`` > 1 fits the ICI and DCN
+    tiers separately (see :func:`calibrate_from_timeline`)."""
     from autodist_tpu.utils.profiling import collective_timeline
     timeline = collective_timeline(trace_dir, line_name=line_name)
     return calibrate_from_timeline(params, timeline, num_replicas,
-                                   cross_node=cross_node)
+                                   cross_node=cross_node,
+                                   devices_per_node=devices_per_node)

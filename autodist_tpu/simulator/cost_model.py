@@ -109,6 +109,15 @@ class CostModelParams:
     # entries — this is what lets a bandwidth-rich ICI topology
     # correctly REJECT the int8 tier while a DCN-bound one picks it.
     quant_s_per_byte: float = 5.0e-12
+    # Two-level (hierarchical) schedules pay a tier-boundary cost the
+    # flat ring does not: the re-layout between the intra-node
+    # reduce-scatter and the inter-node phase (and, under the int8
+    # wire, the boundary requantization) is an extra HBM round trip
+    # over the bucket. Priced per RAW byte, like compress_s_per_byte —
+    # this is what keeps flat the winner on topologies whose "DCN"
+    # is as fast as ICI (single fat switch), where the two extra
+    # phases buy nothing.
+    hier_boundary_s_per_byte: float = 2.5e-12
     calibrated: bool = False
 
     @classmethod
@@ -155,6 +164,121 @@ def collective_time(kind, nbytes, n, alpha, beta):
                 'sparse_all_gather'):
         return (n - 1) * alpha + (n - 1) / n * nbytes * beta
     raise ValueError('Unknown collective kind %r' % (kind,))
+
+
+def hierarchical_time(nbytes, n, nodes, params, ici_bytes=None):
+    """Predicted seconds for a TWO-LEVEL all-reduce of ``nbytes`` wire
+    bytes over ``n`` devices grouped into ``nodes`` node groups of
+    ``g = n/nodes`` devices each (PCCL-style process-group synthesis):
+
+    - intra-node reduce-scatter + all-gather: ``2(g-1)`` ICI hops
+      moving ``(g-1)/g·B_ici`` each phase,
+    - inter-node all-reduce of the owned ``B/g`` chunk over one
+      representative per node: ``2(k-1)`` DCN hops at ``2(k-1)/k·B/g``
+      bytes,
+    - plus the tier-boundary re-layout/requantize HBM pass
+      (``hier_boundary_s_per_byte``, charged on the intra-tier bytes).
+
+    ``ici_bytes`` is the byte count the INTRA phases actually move
+    when it differs from the cross-node wire: the int8 schedule
+    quantizes only at the tier boundary, so its ICI phases ride the
+    full f32 payload while the DCN phase rides the int8 wire
+    (default: same as ``nbytes``).
+
+    The degenerate shapes collapse to the flat formulas: ``nodes=1``
+    is a pure-ICI ring, ``nodes=n`` a pure-DCN ring (plus the
+    boundary term, which is why flat stays preferred there).
+    """
+    n = int(n)
+    k = max(1, int(nodes))
+    if n <= 1:
+        return 0.0
+    nbytes = float(nbytes)
+    ici = nbytes if ici_bytes is None else float(ici_bytes)
+    a_i, b_i = params.link(cross_node=False)
+    a_d, b_d = params.link(cross_node=True)
+    g = max(1, n // k)
+    t = 2.0 * (g - 1) * a_i + 2.0 * (g - 1) / g * ici * b_i
+    if k > 1:
+        t += 2.0 * (k - 1) * a_d + \
+            2.0 * (k - 1) / k * (nbytes / g) * b_d
+        t += ici * params.hier_boundary_s_per_byte
+    return t
+
+
+def choose_hierarchical(nbytes, dtype, compressor, n, nodes, params,
+                        knob='auto', spec='AUTO'):
+    """THE per-bucket flat-vs-two-level decision, shared by
+    ``plan.sync_gradients`` (trace-time emission) and
+    ``plan.static_collective_schedule`` (what predict() prices) so the
+    predicted and traced schedules can never drift.
+
+    Returns True when the bucket should ride the hierarchical
+    schedule. Flat stays the emission (False) on single-node meshes
+    (``nodes <= 1``), non-dividing group layouts, one-device groups
+    (``g == 1`` degenerates to the flat DCN ring), forced RING specs
+    (an explicit flat-ring request), and whenever the two-tier α-β
+    prediction does not beat the flat ring priced at the DCN link —
+    so existing single-node behavior is the degenerate case.
+    """
+    n = int(n)
+    nodes = int(nodes or 0)
+    if n <= 1 or nodes <= 1 or n % nodes or n // nodes <= 1:
+        return False
+    if spec == 'RING' or knob == 'never':
+        return False
+    if knob == 'always':
+        return True
+    wb = wire_bytes(nbytes, dtype, compressor)
+    # the int8 schedule requantizes ONLY at the tier boundary: its
+    # intra-node phases move the full (raw f32) payload on ICI while
+    # the DCN phase rides the int8 wire
+    ici_b = nbytes if compressor == 'Int8RingCompressor' else wb
+    a_d, b_d = params.link(cross_node=True)
+    flat = collective_time('all_reduce', wb, n, a_d, b_d)
+    return hierarchical_time(wb, n, nodes, params,
+                             ici_bytes=ici_b) < flat
+
+
+def num_node_groups(strategy=None, resource_spec=None, num_replicas=None):
+    """Node-group count for hierarchical pricing: distinct hosts among
+    the strategy's replica devices (the same host-major order the mesh
+    builder lays devices out in), falling back to the spec's
+    accelerator-bearing node count. Returns 1 (flat) when the layout
+    is not an EQUAL split — every host must contribute the same number
+    of replica devices and that size must divide the replica count,
+    mirroring ``mesh.data_axis_node_groups``'s equal-group requirement
+    so pricing never assumes a two-level schedule the trace would
+    refuse to emit. The ``AUTODIST_HIERARCHY_NODES`` override takes
+    the same precedence it does at trace time — under the override the
+    emission groups by it regardless of the spec's host layout, and
+    pricing must describe the program that actually runs."""
+    from autodist_tpu.const import ENV
+    forced = ENV.AUTODIST_HIERARCHY_NODES.val
+    if forced and forced >= 2:
+        n = int(num_replicas or 0)
+        if n and n % forced == 0 and n // forced >= 2:
+            return forced
+        return 1
+    hosts = []
+    replicas = list(strategy.graph_config.replicas) if strategy and \
+        strategy.graph_config.replicas else []
+    if replicas:
+        hosts = [d.rsplit(':', 2)[0] for d in replicas]
+    elif resource_spec is not None:
+        per_node = resource_spec.node_accelerator_devices or \
+            {a: [a] for a in resource_spec.nodes}
+        hosts = [h for h, devs in per_node.items() for _ in devs]
+    if not hosts:
+        return 1
+    counts = {}
+    for h in hosts:
+        counts[h] = counts.get(h, 0) + 1
+    k = len(counts)
+    n = int(num_replicas or len(hosts))
+    if k <= 1 or n % k or len(set(counts.values())) != 1:
+        return 1
+    return k
 
 
 @dataclass
@@ -225,7 +349,7 @@ def memory_footprint(strategy, graph_item, num_replicas,
 
 def predict(strategy, graph_item, resource_spec=None, params=None,
             num_replicas=None, optimizer_slots=2,
-            sparse_lookups_per_replica=4096):
+            sparse_lookups_per_replica=4096, nodes=None):
     """Price a built strategy: predicted step time + per-device memory.
 
     Args:
@@ -238,6 +362,10 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         params: :class:`CostModelParams` override (e.g. calibrated).
         optimizer_slots: f32 slot tensors per param for the memory
             estimate (2 = Adam, 1 = momentum, 0 = SGD).
+        nodes: node-group count for hierarchical (two-level) schedule
+            decisions; None derives it from the strategy's replica
+            hosts / the spec (``num_node_groups``). 1 forces flat-only
+            pricing.
 
     Returns a :class:`CostReport`.
     """
@@ -254,10 +382,13 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
     if resource_spec is not None:
         cross_node = resource_spec.topology.multi_node
     alpha, beta = params.link(cross_node=cross_node)
+    if nodes is None:
+        nodes = num_node_groups(strategy, resource_spec, n)
 
     schedule = static_collective_schedule(
         strategy, graph_item, n,
-        sparse_lookups_per_replica=sparse_lookups_per_replica)
+        sparse_lookups_per_replica=sparse_lookups_per_replica,
+        nodes=nodes, params=params)
     breakdown = []
     sync = 0.0
     grad_ar = [i for i, e in enumerate(schedule)
@@ -266,7 +397,16 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
     exposed = 0.0
     for i, e in enumerate(schedule):
         wb = wire_bytes(e['bytes'], e['dtype'], e.get('compressor'))
-        t = collective_time(e['kind'], wb, n, alpha, beta)
+        hier = int(e.get('hier', 0))
+        if hier > 1 and e['kind'] == 'all_reduce':
+            # two-level schedule: ICI phases + DCN phase + boundary.
+            # int8 buckets quantize only at the tier boundary, so
+            # their intra phases move the raw f32 bytes on ICI.
+            ici_b = e['bytes'] \
+                if e.get('compressor') == 'Int8RingCompressor' else wb
+            t = hierarchical_time(wb, n, hier, params, ici_bytes=ici_b)
+        else:
+            t = collective_time(e['kind'], wb, n, alpha, beta)
         if wb < e['bytes']:   # compressor cast: two HBM passes per end
             t += e['bytes'] * params.compress_s_per_byte
         if e.get('compressor') == 'Int8RingCompressor':
@@ -292,6 +432,7 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         breakdown.append({
             'kind': e['kind'], 'phase': e['phase'], 'vars': e['vars'],
             'bytes': e['bytes'], 'wire_bytes': wb,
+            'hier': hier,
             'time_s': t, 'exposed_time_s': t_exposed,
             'members': e['members'][:4] + (
                 ['... %d more' % (len(e['members']) - 4)]

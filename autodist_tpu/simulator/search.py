@@ -57,6 +57,15 @@ def default_candidates(chunk_sizes=(32, 128, 512)):
         ('AllReduce(int8-wire)',
          lambda: b.AllReduce(compressor='Int8RingCompressor')),
         ('AllReduce(RING)', lambda: b.AllReduce(all_reduce_spec='RING')),
+        # two-level schedule knob: 'always' forces hierarchical
+        # emission wherever node groups exist (on a single-node spec
+        # the schedule degenerates to the flat ring and the candidate
+        # ties — flat wins the name tie-break); 'never' is the flat
+        # control the multi-node A/B reads against
+        ('AllReduce(hierarchical)',
+         lambda: b.AllReduce(hierarchical='always')),
+        ('AllReduce(flat-only)',
+         lambda: b.AllReduce(hierarchical='never')),
         ('PartitionedAR', lambda: b.PartitionedAR()),
         ('RandomAxisPartitionAR',
          lambda: b.RandomAxisPartitionAR(seed=0)),
@@ -71,13 +80,16 @@ def default_candidates(chunk_sizes=(32, 128, 512)):
 
 def rank(graph_item, resource_spec, candidates=None,
          memory_budget_bytes=None, params=None, num_replicas=None,
-         optimizer_slots=2, sparse_lookups_per_replica=4096):
+         optimizer_slots=2, sparse_lookups_per_replica=4096,
+         nodes=None):
     """Build + price every candidate; return (feasible, infeasible).
 
     ``feasible`` is sorted by (predicted step time, peak bytes, name)
     and each entry's ``strategy.cost`` carries the prediction summary.
     ``infeasible`` holds candidates pruned by the memory budget or whose
     build raised (with ``error`` set) — kept for the ranked table.
+    ``nodes`` overrides the node-group count hierarchical pricing uses
+    (None = derive from the spec; 1 = price everything flat).
     """
     if candidates is None:
         candidates = default_candidates()
@@ -90,7 +102,8 @@ def rank(graph_item, resource_spec, candidates=None,
                 strategy, graph_item, resource_spec, params=params,
                 num_replicas=num_replicas,
                 optimizer_slots=optimizer_slots,
-                sparse_lookups_per_replica=sparse_lookups_per_replica)
+                sparse_lookups_per_replica=sparse_lookups_per_replica,
+                nodes=nodes)
         except Exception as e:   # noqa: BLE001 - one bad candidate
             # must not kill the search (e.g. a builder that needs
             # devices this spec does not have)

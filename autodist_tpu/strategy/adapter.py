@@ -196,8 +196,9 @@ def grad_bucket_layout(strategy, graph_item):
 
     # mirror sync_gradients' fusable filter and grouping key exactly:
     # only stateless compressors fuse (stateful ones reduce per-var),
-    # and the key includes the gradient dtype (mixed-dtype groups split)
-    groups = {}   # (group, compressor, spec, dtype) -> [(name, nb, ch)]
+    # the key includes the gradient dtype (mixed-dtype groups split)
+    # and the hierarchical knob (mixed flat/two-level members split)
+    groups = {}   # (group, compressor, spec, dtype, hier) -> items
     for node in strategy.node_config:
         sync = node.synchronizer if not node.part_config \
             else node.part_config[0]
@@ -214,10 +215,12 @@ def grad_bucket_layout(strategy, graph_item):
             np.dtype(var.dtype).itemsize
         groups.setdefault(
             (sync.group, sync.compressor, sync.spec,
-             str(np.dtype(var.dtype))), []).append(
+             str(np.dtype(var.dtype)),
+             getattr(sync, 'hierarchical', 'auto') or 'auto'),
+            []).append(
             (node.var_name, nbytes, getattr(sync, 'chunk_size', 0)))
     out = []
-    for (group, _, _, _), items in sorted(groups.items(), reverse=True):
+    for (group, *_), items in sorted(groups.items(), reverse=True):
         chunk = max(c for _, _, c in items)
         cap = bucket_bytes_cap(chunk)
         rev = [(name, nbytes) for name, nbytes, _ in reversed(items)]

@@ -65,11 +65,18 @@ class AllReduceSynchronizer:
     overlap the backward pass instead of serializing behind it. 0 means
     "unspecified" (legacy strategies) and falls back to
     const.DEFAULT_CHUNK_SIZE.
+    ``hierarchical`` governs two-level (intra-node reduce-scatter ->
+    inter-node all-reduce -> intra-node all-gather) bucket emission on
+    multi-node meshes: 'auto' (default — the simulator's cost model
+    decides per bucket; flat is the degenerate single-node case),
+    'never' (always the flat ring) or 'always' (force two-level where
+    node groups exist). Legacy strategies deserialize to 'auto'.
     """
     spec: str = 'AUTO'            # AUTO | RING
     compressor: str = 'NoneCompressor'
     group: int = 0
     chunk_size: int = 0
+    hierarchical: str = 'auto'    # auto | never | always
     kind: str = 'AllReduce'
 
 

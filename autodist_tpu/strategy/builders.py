@@ -178,12 +178,13 @@ class AllReduce(StrategyBuilder):
     """All dense variables via grouped collective all-reduce."""
 
     def __init__(self, chunk_size=128, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor'):
+                 compressor='NoneCompressor', hierarchical='auto'):
         if chunk_size < 1:
             raise ValueError('The chunk_size must be greater than zero.')
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        self.hierarchical = hierarchical
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -196,7 +197,8 @@ class AllReduce(StrategyBuilder):
                     spec=self.all_reduce_spec,
                     compressor=self.compressor,
                     group=i // self.chunk_size,
-                    chunk_size=self.chunk_size)))
+                    chunk_size=self.chunk_size,
+                    hierarchical=self.hierarchical)))
         return s
 
 
@@ -204,10 +206,11 @@ class PartitionedAR(StrategyBuilder):
     """Axis-0 partitioning, each shard synced by all-reduce."""
 
     def __init__(self, chunk_size=128, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor'):
+                 compressor='NoneCompressor', hierarchical='auto'):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        self.hierarchical = hierarchical
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -231,7 +234,8 @@ class PartitionedAR(StrategyBuilder):
             return AllReduceSynchronizer(
                 spec=self.all_reduce_spec, compressor=self.compressor,
                 group=(counter + i) // self.chunk_size,
-                chunk_size=self.chunk_size)
+                chunk_size=self.chunk_size,
+                hierarchical=self.hierarchical)
 
         if num_shards <= 1:
             return StrategyNode(var_name=var.name,
@@ -327,9 +331,13 @@ class AutoStrategy(StrategyBuilder):
         params = self._cost_params or CostModelParams.from_topology(
             resource_spec.topology)
         if self._trace_dir:
+            from autodist_tpu.simulator.cost_model import num_node_groups
+            k = num_node_groups(resource_spec=resource_spec,
+                                num_replicas=n)
             params = calibrate_from_trace(
                 params, self._trace_dir, n,
-                cross_node=resource_spec.topology.multi_node)
+                cross_node=resource_spec.topology.multi_node,
+                devices_per_node=n // k if k > 1 else 0)
         feasible, infeasible = search.rank(
             graph_item, resource_spec, candidates=self._candidates,
             memory_budget_bytes=self._budget, params=params,
@@ -365,10 +373,12 @@ class Parallax(StrategyBuilder):
 
     def __init__(self, chunk_size=128, local_proxy_variable=False,
                  sync=True, staleness=0, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor', shared_optimizer=False):
+                 compressor='NoneCompressor', shared_optimizer=False,
+                 hierarchical='auto'):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        self.hierarchical = hierarchical
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
@@ -397,6 +407,7 @@ class Parallax(StrategyBuilder):
                         spec=self.all_reduce_spec,
                         compressor=self.compressor,
                         group=dense_count // self.chunk_size,
-                        chunk_size=self.chunk_size)))
+                        chunk_size=self.chunk_size,
+                        hierarchical=self.hierarchical)))
                 dense_count += 1
         return s

@@ -378,12 +378,21 @@ def format_health(report):
         lines.append('  observed join: %s at epoch %d'
                      % (j.get('worker'), j.get('epoch', -1)))
     for r in report.get('replans', ()):
-        lines.append('  replan @world=%d: predicted %s vs kept %s%s'
+        if r.get('migrated'):
+            mig = r.get('migration') or {}
+            status = ' [MIGRATED to %s in %.3fs via reshard %s]' % (
+                mig.get('builder', '?'), mig.get('wall_s', 0.0),
+                (mig.get('reshard') or {}).get('kinds', {}))
+        elif r.get('migration_error'):
+            status = ' [migration failed: %s]' % r['migration_error']
+        else:
+            status = ''
+        lines.append('  replan @world=%d: predicted %s vs kept %s%s%s'
                      % (r.get('world', -1),
                         r.get('predicted', '?'),
                         r.get('kept') or '(hand-picked)',
                         ' [error: %s]' % r['error']
-                        if r.get('error') else ''))
+                        if r.get('error') else '', status))
     auto = report.get('autoscale') or {}
     if auto.get('decisions'):
         lines.append('  autoscale: %d taken / %d skipped / %d failed'

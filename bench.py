@@ -435,12 +435,15 @@ def probed_devices():
 
 
 def _bucketed_sync_program(compressor='NoneCompressor', n_vars=16,
-                           dim=128, chunk=2):
+                           dim=128, chunk=2, hierarchical='auto'):
     """Compile the bucketed gradient-sync program ALONE for an
     ``AllReduce(chunk_size=chunk, compressor=...)`` strategy over
     ``n_vars`` synthetic [dim, dim] f32 gradients. The single harness
-    behind bench_grad_sync AND the quantized A/B — one timing/mesh
-    protocol, so f32-vs-int8 comparisons can never drift apart.
+    behind bench_grad_sync AND the quantized/hierarchical A/Bs — one
+    timing/mesh protocol, so the compared wires can never drift apart.
+    ``hierarchical`` is the strategy knob ('never' = flat control,
+    'always' = two-level where node groups exist — set
+    ``AUTODIST_HIERARCHY_NODES`` to give the CPU mesh node structure).
     Returns (compiled fn, grads, plan, static layout, device count).
     """
     import jax
@@ -467,8 +470,8 @@ def _bucketed_sync_program(compressor='NoneCompressor', n_vars=16,
     rs = ResourceSpec(resource_info={'nodes': [{
         'address': 'localhost', 'chief': True, 'cpus': [0],
         'gpus': list(range(len(devs))), 'network_bandwidth': 100}]})
-    strategy = AllReduce(chunk_size=chunk,
-                         compressor=compressor).build(gi, rs)
+    strategy = AllReduce(chunk_size=chunk, compressor=compressor,
+                         hierarchical=hierarchical).build(gi, rs)
     layout = grad_bucket_layout(strategy, gi)
     mesh = Mesh(np.asarray(devs), (AXIS_DATA,))
     plan = ExecutionPlan(strategy, gi, mesh)
@@ -653,6 +656,90 @@ def _bench_quantized_ps_push(steps):
         if push8 else 0.0,
         'state_max_abs_diff': float(np.abs(w32 - w8).max()),
     }
+
+
+def bench_hierarchical(steps=8, nodes=2):
+    """Topology-aware hierarchical collectives A/B (ISSUE 9).
+
+    The SAME bucketed gradient-sync program (16 x 64 KiB grads,
+    chunk_size=2) compiled and timed with the flat ring emission
+    (``hierarchical='never'``) and the two-level schedule
+    (``'always'``: intra-node reduce-scatter -> inter-node all-reduce
+    -> intra-node all-gather), with ``AUTODIST_HIERARCHY_NODES``
+    giving the mesh ``nodes`` node groups. On the virtual CPU mesh
+    both tiers ride host memory, so wall times mostly A/B the schedule
+    OVERHEAD (like ``quantized``'s CPU fallback); the load-bearing
+    records are the per-tier bytes — what each schedule puts on the
+    DCN link per device per step — and the divergence of the synced
+    gradients (two-level regrouping is pure re-association, so the
+    diff is bounded by one f32 ulp of the sum on these random grads;
+    ``tests/test_hierarchical.py`` pins BIT-identity on exactly-
+    representable sums).
+
+    Never raises: meshes that cannot form >= 2 node groups of >= 2
+    devices degrade to an ``{'error': ...}`` entry so the bench still
+    emits its one JSON line.
+    """
+    try:
+        return _bench_hierarchical_inner(steps, nodes)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _bench_hierarchical_inner(steps, nodes):
+    devs = probed_devices()
+    n = len(devs)
+    if nodes < 2 or n % nodes or n // nodes < 2:
+        return {'error': 'mesh of %d devices cannot form %d node '
+                         'groups of >= 2' % (n, nodes)}
+    g = n // nodes
+    saved = os.environ.get('AUTODIST_HIERARCHY_NODES')
+    os.environ['AUTODIST_HIERARCHY_NODES'] = str(nodes)
+    try:
+        result = {}
+        outputs = {}
+        for knob, key in (('never', 'flat'), ('always', 'two_level')):
+            f, grads, plan, layout, _ = _bucketed_sync_program(
+                hierarchical=knob)
+            med, outs = _time_sync_program(f, grads, steps)
+            emitted = list(plan.last_bucket_stats)
+            outputs[key] = outs
+            raw = sum(b['bytes'] for b in emitted)
+            if key == 'flat':
+                tiers = {'ici_bytes': 0,
+                         'dcn_bytes': int(2 * (n - 1) / n * raw)}
+            else:
+                hier_raw = sum(b['bytes'] for b in emitted
+                               if b.get('hier'))
+                flat_raw = raw - hier_raw
+                tiers = {
+                    'ici_bytes': int(2 * (g - 1) / g * hier_raw),
+                    'dcn_bytes': int(2 * (nodes - 1) / nodes *
+                                     hier_raw / g +
+                                     2 * (n - 1) / n * flat_raw)}
+            result[key] = dict({
+                'per_step_sync_time_s': round(med / steps, 6),
+                'bucket_count': len(emitted),
+                'hier_buckets': sum(1 for b in emitted
+                                    if b.get('hier')),
+                'sync_bytes': raw,
+            }, **tiers)
+        flat_dcn = result['flat']['dcn_bytes']
+        two_dcn = result['two_level']['dcn_bytes']
+        result['dcn_bytes_reduction'] = round(flat_dcn / two_dcn, 2) \
+            if two_dcn else 0.0
+        result['state_max_abs_diff'] = float(max(
+            np.abs(np.asarray(a) - np.asarray(b)).max()
+            for a, b in zip(outputs['flat'], outputs['two_level']))) \
+            if outputs['flat'] else 0.0
+        result['nodes'] = nodes
+        result['devices'] = n
+        return result
+    finally:
+        if saved is None:
+            os.environ.pop('AUTODIST_HIERARCHY_NODES', None)
+        else:
+            os.environ['AUTODIST_HIERARCHY_NODES'] = saved
 
 
 def bench_simulator(steps=20):
@@ -1303,9 +1390,15 @@ def _bench_elastic_inner(steps, join_at):
     proc = ensure_service(port=port)
     saved = {k: os.environ.get(k)
              for k in ('AUTODIST_PEER_FAILURE_POLICY',
-                       'AUTODIST_HEARTBEAT_TIMEOUT')}
+                       'AUTODIST_HEARTBEAT_TIMEOUT',
+                       'AUTODIST_EXECUTE_REPLAN')}
     os.environ['AUTODIST_PEER_FAILURE_POLICY'] = 'exclude'
     os.environ['AUTODIST_HEARTBEAT_TIMEOUT'] = str(hb_timeout)
+    # execute the chief's re-rank through the device-side reshard path
+    # (ROADMAP item 3): the scaled run MIGRATES to the re-ranked
+    # strategy mid-run, and the final-state diff below must stay 0.0 —
+    # the migration moves values, never recomputes them
+    os.environ['AUTODIST_EXECUTE_REPLAN'] = '1'
     try:
         base_walls, w_fixed, _, _ = _elastic_run(port, steps, None)
         walls, w_scaled, report, admit = _elastic_run(
@@ -1344,7 +1437,9 @@ def _bench_elastic_inner(steps, join_at):
         'epoch': report.get('epoch', 0),
         'replans': [
             {k: r.get(k) for k in ('world', 'kept', 'predicted',
-                                   'predicted_step_time_s', 'error')
+                                   'predicted_step_time_s', 'error',
+                                   'migrated', 'migration_staged',
+                                   'migration', 'migration_error')
              if r.get(k) is not None}
             for r in report.get('replans', [])],
     }
@@ -1477,6 +1572,7 @@ def main():
         result['extra']['sparse_ps'] = bench_sparse_ps()
         result['extra']['elastic'] = bench_elastic()
         result['extra']['quantized'] = bench_quantized()
+        result['extra']['hierarchical'] = bench_hierarchical()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -1495,6 +1591,7 @@ def main():
     sparse_ps = bench_sparse_ps()
     elastic = bench_elastic()
     quantized = bench_quantized()
+    hierarchical = bench_hierarchical()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -1515,6 +1612,7 @@ def main():
                 'sparse_ps': sparse_ps,
                 'elastic': elastic,
                 'quantized': quantized,
+                'hierarchical': hierarchical,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -1570,7 +1668,8 @@ def main():
                       'recovery': recovery,
                       'sparse_ps': sparse_ps,
                       'elastic': elastic,
-                      'quantized': quantized},
+                      'quantized': quantized,
+                      'hierarchical': hierarchical},
         }
     print(json.dumps(result))
 

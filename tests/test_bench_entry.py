@@ -72,6 +72,16 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert 'error' not in qp, qp
         assert qp['push_bytes_reduction'] >= 3.0, qp
         assert qp['state_max_abs_diff'] < 0.05
+    # ISSUE 9: every record carries the hierarchical A/B under its
+    # stable key — the two-level schedule really emitted, it puts
+    # ~g x fewer bytes on the DCN tier, and the synced gradients
+    # diverge by at most f32 re-association noise
+    h = extra['hierarchical']
+    assert 'error' not in h, h
+    assert h['two_level']['hier_buckets'] >= 1, h
+    assert h['flat']['hier_buckets'] == 0, h
+    assert h['dcn_bytes_reduction'] >= 3.0, h
+    assert h['state_max_abs_diff'] < 1e-5, h
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

@@ -62,14 +62,24 @@ def build_resource_spec(args):
     from autodist_tpu.resource_spec import ResourceSpec
     if args.resource_spec:
         return ResourceSpec(resource_file=args.resource_spec)
-    node = {'address': 'localhost', 'chief': True, 'cpus': [0],
-            'network_bandwidth': 100}
+    n_nodes = max(1, args.nodes)
+    if args.devices % n_nodes:
+        raise SystemExit('--nodes %d must divide --devices %d'
+                         % (n_nodes, args.devices))
+    per = args.devices // n_nodes
     key = {'tpu': 'tpus', 'gpu': 'gpus', 'cpu': 'cpus'}[args.device_type]
-    if args.device_type == 'cpu':
-        node['cpus'] = list(range(args.devices))
-    else:
-        node[key] = list(range(args.devices))
-    return ResourceSpec(resource_info={'nodes': [node]})
+    nodes = []
+    for i in range(n_nodes):
+        node = {'address': 'host%d' % i if n_nodes > 1 else 'localhost',
+                'cpus': [0], 'network_bandwidth': 100}
+        if i == 0:
+            node['chief'] = True
+        if args.device_type == 'cpu':
+            node['cpus'] = list(range(per))
+        else:
+            node[key] = list(range(per))
+        nodes.append(node)
+    return ResourceSpec(resource_info={'nodes': nodes})
 
 
 def main(argv=None):
@@ -106,6 +116,15 @@ def main(argv=None):
                         'per step (batch-derived); sparse variables\' '
                         'PS traffic is priced by touched rows, not '
                         'full table size')
+    p.add_argument('--nodes', type=int, default=1,
+                   help='synthesize this many nodes (devices split '
+                        'evenly); >= 2 makes the spec multi-node so '
+                        'DCN pricing and hierarchical schedules engage')
+    p.add_argument('--hierarchical', action='store_true',
+                   help='print BOTH rankings: hierarchical-aware '
+                        '(two-level schedules where the cost model '
+                        'picks them) and flat-forced — the per-'
+                        'topology A/B the schedules are chosen by')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -137,23 +156,42 @@ def main(argv=None):
         gi, rs, memory_budget_bytes=budget, params=params,
         num_replicas=n, optimizer_slots=slots,
         sparse_lookups_per_replica=args.sparse_lookups)
+    flat = None
+    if args.hierarchical:
+        # the flat-forced control ranking: nodes=1 prices every bucket
+        # as a flat ring regardless of the spec's node structure
+        flat = search.rank(
+            gi, rs, memory_budget_bytes=budget, params=params,
+            num_replicas=n, optimizer_slots=slots,
+            sparse_lookups_per_replica=args.sparse_lookups, nodes=1)
+
+    def cand_json(feas, infeas):
+        return [dict(c.strategy.cost, feasible=True) for c in feas] + \
+            [{'builder': c.name, 'feasible': False, 'error': c.error}
+             for c in infeas]
+
     if args.json:
-        print(json.dumps({
+        out = {
             'model': args.model,
             'topology': repr(rs.topology),
             'memory_budget_bytes': budget,
-            'candidates': [
-                dict(c.strategy.cost, feasible=True)
-                for c in feasible] + [
-                {'builder': c.name, 'feasible': False, 'error': c.error}
-                for c in infeasible],
-        }))
+            'candidates': cand_json(feasible, infeasible),
+        }
+        if flat is not None:
+            out['candidates_flat'] = cand_json(*flat)
+        print(json.dumps(out))
         return 0
     print('model=%s  vars=%d  %r  replicas=%d%s' % (
         args.model, len(gi.trainable_var_op_to_var), rs.topology,
         feasible[0].report.num_replicas if feasible else 0,
         '  budget=%.1fGB' % args.budget_gb if budget else ''))
+    if flat is not None:
+        print('-- hierarchical-aware ranking '
+              '(two-level where the cost model picks it) --')
     print(search.format_ranked_table(feasible, infeasible))
+    if flat is not None:
+        print('-- flat-forced ranking (every bucket a flat ring) --')
+        print(search.format_ranked_table(*flat))
     return 0
 
 
