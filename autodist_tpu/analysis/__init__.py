@@ -1,0 +1,34 @@
+"""Static analysis over the distributed runtime — tier-1 correctness
+backstops that run with no devices and no processes.
+
+Four analyzers, one CLI (``tools/analyze.py``):
+
+- :mod:`~autodist_tpu.analysis.protocol_model` +
+  :mod:`~autodist_tpu.analysis.explore` — an executable small-scope
+  model of the control-plane protocol (fence generations, the exclude
+  path, the admit handshake, publish/MINWAIT gate semantics) explored
+  exhaustively over bounded interleavings with crashes. The two
+  costliest historical bugs (PR 4's deleted-step-key resurrection,
+  PR 6's admit-ordering inversion) re-derive as counterexample traces
+  when the model is flipped to the pre-fix orderings; HEAD's orderings
+  explore clean.
+- :mod:`~autodist_tpu.analysis.fence_lint` — parses the native
+  ``coord_service.cc`` dispatcher and proves every mutating command is
+  fence-checked (with the under-tensor-lock re-check for ``B*``
+  commands) and documented; absorbs ``tools/check_protocol.py``.
+- :mod:`~autodist_tpu.analysis.env_lint` — every ``AUTODIST_*`` env
+  read in the tree must be declared in ``const.py``'s ENV registry,
+  and every worker-affecting knob must ride the coordinator's
+  forwarding set (or carry an explicit exemption reason).
+- :mod:`~autodist_tpu.analysis.schedule_lint` — cross-checks
+  ``plan.sync_gradients``'s emission predicates against
+  ``static_collective_schedule`` at the AST level, verifies
+  ``reshard.plan_reshard`` layout moves are element-preserving by
+  shape algebra, and absorbs the wire-pricing drift check.
+
+Every analyzer returns a list of finding strings (empty = clean) so
+``tools/analyze.py --all`` can aggregate them into one exit code and an
+optional ``--json`` report. Design notes and the extension contract
+(required reading before adding a protocol message — ROADMAP 3a):
+``docs/design/static-analysis.md``.
+"""

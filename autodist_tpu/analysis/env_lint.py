@@ -1,0 +1,170 @@
+"""Env-knob lint: no undeclared ``AUTODIST_*`` reads, no silently
+unforwarded knobs.
+
+Two invariants over the whole tree:
+
+1. **Declaration** — every ``AUTODIST_*`` environment read (Python
+   ``os.environ[...]``/``os.environ.get``/``os.getenv``, C++
+   ``getenv``) must name a member of ``const.py``'s typed ENV
+   registry, or carry an explicit entry in :data:`ALLOWED_RAW_READS`
+   with a reason. A raw read of an undeclared name is a knob with no
+   validation, no documentation surface and no forwarding decision —
+   exactly how ``AUTODIST_FUSED_CONV`` and ``AUTODIST_PP_STASH_LIMIT_MB``
+   lived unregistered for several PRs.
+2. **Forwarding** — every ENV member must either ride the
+   coordinator's ``_FORWARDED_FLAGS`` (worker-affecting knobs reach
+   every launched worker) or appear in :data:`FORWARD_EXEMPT` with the
+   reason it deliberately does not (per-worker identity, chief-side
+   only, security transport, explicit-install chaos knobs). A knob in
+   neither set is a finding: an operator exporting it on the chief
+   would silently configure only the chief.
+
+Writes (``os.environ[k] = v``, ``.setdefault``, ``.pop``, ``del``,
+``monkeypatch.setenv``) are not reads and are ignored.
+"""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Scanned roots, relative to the repo.
+SCAN_ROOTS = ('autodist_tpu', 'tools', 'tests', 'examples', 'bench.py',
+              '__graft_entry__.py')
+
+#: Undeclared raw reads allowed, with the reason. Empty on HEAD: every
+#: knob the tree reads is registered. Add entries only for names that
+#: deliberately must not enter the registry (none known today).
+ALLOWED_RAW_READS = {}
+
+#: ENV members that deliberately do NOT ride ``_FORWARDED_FLAGS``,
+#: with the reason. Everything else must be forwarded.
+FORWARD_EXEMPT = {
+    'AUTODIST_WORKER':
+        'per-worker identity, set explicitly by Coordinator._worker_env',
+    'AUTODIST_STRATEGY_ID':
+        'per-launch value, set explicitly by Coordinator._worker_env',
+    'AUTODIST_PROCESS_ID':
+        'per-worker identity, set explicitly by Coordinator._worker_env',
+    'AUTODIST_NUM_PROCESSES':
+        'per-launch value, set explicitly by Coordinator._worker_env',
+    'AUTODIST_COORDINATOR_ADDR':
+        'per-launch value, set explicitly by Coordinator._worker_env',
+    'AUTODIST_RUN_ID':
+        'per-launch nonce, issued and set explicitly by the launcher',
+    'AUTODIST_DEBUG_REMOTE':
+        'chief-side launcher behavior (print instead of ssh)',
+    'AUTODIST_DUMP_GRAPHS':
+        'per-process debug dumps; divergence is harmless',
+    'AUTODIST_COORD_TOKEN':
+        'deliberately not forwarded: env assignments ride the remote '
+        'ssh command line (world-readable in ps); the secret ships as '
+        'a mode-0600 file via AUTODIST_COORD_TOKEN_FILE instead',
+    'AUTODIST_COORD_TOKEN_FILE':
+        'set explicitly per worker after the token file is copied',
+    'AUTODIST_ELASTIC_JOIN':
+        'set per joiner by Coordinator.scale_up, never on the launch '
+        'cohort',
+    'AUTODIST_AUTO_CHECKPOINT_EVERY':
+        'chief-side checkpoint backstop; workers never act on it',
+    'AUTODIST_EXECUTE_REPLAN':
+        'chief-side migration opt-in (cohort-wide propagation is '
+        'ROADMAP 3a)',
+    'AUTODIST_FAULT_PLAN':
+        'chaos-only: honored only where a FaultLine is explicitly '
+        'installed; production sessions never read it',
+}
+
+_PY_READ = re.compile(
+    r'''os\.environ\.get\(\s*['"](AUTODIST_\w+)['"]'''
+    r'''|os\.getenv\(\s*['"](AUTODIST_\w+)['"]'''
+    r'''|(?<!del )os\.environ\[['"](AUTODIST_\w+)['"]\](?![ \t]*=[^=])''')
+_CC_READ = re.compile(r'getenv\("(AUTODIST_\w+)"\)')
+
+
+def _iter_sources():
+    for root in SCAN_ROOTS:
+        path = os.path.join(REPO, root)
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ('__pycache__', '.git')]
+            for fn in filenames:
+                if fn.endswith(('.py', '.cc', '.h')):
+                    yield os.path.join(dirpath, fn)
+
+
+def raw_reads(files=None):
+    """``[(relpath, lineno, name)]`` for every AUTODIST_* env read.
+
+    Scans whole-file text (not per-line) so a call wrapped across lines
+    for the 72-column style — ``os.environ.get(\\n    'AUTODIST_X')`` —
+    still matches."""
+    out = []
+    own = os.path.abspath(__file__)
+    for path in (files if files is not None else _iter_sources()):
+        if os.path.abspath(path) == own:
+            continue   # this module's own regex literals are not reads
+        pat = _CC_READ if path.endswith(('.cc', '.h')) else _PY_READ
+        with open(path, encoding='utf-8', errors='replace') as f:
+            text = f.read()
+        for m in pat.finditer(text):
+            name = next(g for g in m.groups() if g)
+            out.append((os.path.relpath(path, REPO),
+                        text.count('\n', 0, m.start()) + 1, name))
+    return out
+
+
+def declared_env():
+    from autodist_tpu.const import ENV
+    return {e.name for e in ENV}
+
+
+def forwarded_env():
+    from autodist_tpu.runtime.coordinator import _FORWARDED_FLAGS
+    return {e.name for e in _FORWARDED_FLAGS}
+
+
+def analyze(files=None):
+    """Run both invariants. Returns finding strings (empty = clean)."""
+    findings = []
+    declared = declared_env()
+    for relpath, lineno, name in raw_reads(files):
+        if name in declared:
+            continue
+        if name in ALLOWED_RAW_READS:
+            continue
+        findings.append(
+            '%s:%d: reads undeclared env knob %s — register it in '
+            "const.py's ENV (typed, validated, forwardable) or "
+            'allowlist it in analysis/env_lint.py with a reason'
+            % (relpath, lineno, name))
+    for name in sorted(set(ALLOWED_RAW_READS) & declared):
+        findings.append(
+            'env_lint.ALLOWED_RAW_READS lists %s, which IS declared in '
+            "const.py's ENV — stale allowlist entry" % name)
+    forwarded = forwarded_env()
+    for name in sorted(declared):
+        if not name.startswith('AUTODIST_'):
+            continue    # SYS_* reference-parity paths judged by hand
+        in_fwd = name in forwarded
+        in_exempt = name in FORWARD_EXEMPT
+        if in_fwd and in_exempt:
+            findings.append(
+                'env knob %s is BOTH in coordinator._FORWARDED_FLAGS '
+                'and env_lint.FORWARD_EXEMPT — resolve the conflict'
+                % name)
+        elif not in_fwd and not in_exempt:
+            findings.append(
+                'env knob %s is declared but neither forwarded '
+                '(coordinator._FORWARDED_FLAGS) nor exempted with a '
+                'reason (env_lint.FORWARD_EXEMPT): an operator '
+                'exporting it on the chief silently configures only '
+                'the chief' % name)
+    for name in sorted(set(FORWARD_EXEMPT) - declared):
+        findings.append(
+            'env_lint.FORWARD_EXEMPT lists %s, which is not an ENV '
+            'member — stale exemption' % name)
+    return findings

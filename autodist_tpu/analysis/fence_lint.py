@@ -1,0 +1,230 @@
+"""Fence-coverage lint over the native coord-service dispatcher.
+
+Statically parses ``native/coord_service.cc`` and proves, per
+dispatched command, the writer-fencing contract the elastic-recovery
+protocol rests on (PR 4): every MUTATING command must check
+``is_fenced``/``is_fenced_locked`` and have an ``ERR fenced``
+(``kFencedErr``) reply path, and every tensor-mutating ``B*`` command
+must ALSO re-check under the tensor lock
+(``reject_fenced_under_tensor_lock``) so one in-flight zombie frame
+cannot commit after its fence bump.
+
+The classification table below is the lint's ground truth: a command
+the dispatcher matches that appears in NEITHER table is a finding —
+adding a protocol command forces an explicit fencing decision here
+(and a model-checker look; see ``docs/design/static-analysis.md``).
+
+Absorbs ``tools/check_protocol.py``: the header comment's command
+table must match the dispatcher's ``cmd == "..."`` set, and the header
+paragraph enumerating the fenced mutating commands must match the
+MUTATING table (BSTAT and BSADD have each drifted out of the header
+before).
+"""
+import os
+import re
+
+SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    'autodist_tpu', 'native', 'coord_service.cc')
+
+#: Commands that mutate durable state: each must be fence-checked with
+#: an ERR fenced path. Values are the rationale (documentation the
+#: lint enforces reading when the table changes).
+MUTATING = {
+    'SET': 'writes kv state',
+    'DEL': 'erases a key/counter — a zombie delete corrupts state as '
+           'surely as a write',
+    'DELNS': 'purges a whole namespace',
+    'INCR': 'advances counters (step publishes, claims, epochs); '
+            'delta-0 reads are exempt inside the handler',
+    'BSET': 'overwrites tensor data',
+    'BADD': 'accumulates into tensor data',
+    'BSADD': 'row-sparse scatter-add into tensor data',
+    'BSTEP': 'applies an optimizer update to PS-resident state',
+}
+
+#: Tensor-mutating commands additionally re-check the fence under the
+#: tensor lock: the global-mu check alone leaves a window where a
+#: zombie frame already past it commits after the fence bump.
+TENSOR_MUTATING = ('BSET', 'BADD', 'BSADD', 'BSTEP')
+
+#: Commands allowed to skip the fence check, with the reason. Reads
+#: and waits never fence (a zombie observing the world is harmless).
+ALLOWED_UNFENCED = {
+    'GET': 'read',
+    'BGET': 'read (torn-read version contract)',
+    'BSTAT': 'read (tensor introspection)',
+    'BGETROWS': 'read (row fetch)',
+    'WAITGE': 'wait (no mutation)',
+    'MINWAIT': 'wait (no mutation)',
+    'PING': 'liveness probe',
+    'FENCE': 'binds the generation itself (rejects superseded binds)',
+    'BARRIER': 'transient rendezvous arrivals only — withdrawn on '
+               'timeout, never durable state; completing a round '
+               'still needs k-1 live parties',
+    'SHUTDOWN': 'operator action (sets the shutting_down flag only)',
+}
+
+#: AUTH is consumed by the connection handshake (serve_conn) before any
+#: command reaches handle(); it belongs in the header but can never
+#: appear in the dispatcher.
+HANDSHAKE_ONLY = {'AUTH'}
+
+
+def _read(text=None):
+    if text is None:
+        with open(SRC) as f:
+            text = f.read()
+    return text
+
+
+def documented_commands(text):
+    """Commands listed in the header comment's protocol table: lines of
+    the form ``//   CMD <args...> -> reply`` before the first
+    ``#include``."""
+    header = text.split('#include', 1)[0]
+    return set(re.findall(r'^//   ([A-Z][A-Z0-9]*)\b', header, re.M))
+
+
+def header_fenced_commands(text):
+    """The mutating-command enumeration in the header's writer-fencing
+    paragraph ('every mutating command on the connection — X, Y — is
+    rejected ...')."""
+    header = text.split('#include', 1)[0]
+    m = re.search(r'every mutating command[^—]*—([^—]+)—', header,
+                  re.S)
+    if not m:
+        return None
+    return set(re.findall(r'\b([A-Z][A-Z0-9]*)\b', m.group(1)))
+
+
+def _handle_body(text):
+    """The body of the ``handle()`` function (the dispatcher) — scoped
+    so ``payload_size``'s own ``cmd ==`` matches don't alias."""
+    m = re.search(r'std::string handle\(', text)
+    if not m:
+        return None
+    i = text.index('{', m.end())
+    depth = 0
+    for j in range(i, len(text)):
+        if text[j] == '{':
+            depth += 1
+        elif text[j] == '}':
+            depth -= 1
+            if depth == 0:
+                return text[i:j + 1]
+    return None
+
+
+def dispatched_blocks(text):
+    """``{command: block source}`` for every ``if (cmd == "X")`` in the
+    dispatcher — the braced block, or the single statement for
+    brace-less arms (PING)."""
+    body = _handle_body(text)
+    if body is None:
+        return {}
+    blocks = {}
+    for m in re.finditer(r'if \(cmd == "([A-Z][A-Z0-9]*)"\)', body):
+        cmd = m.group(1)
+        k = m.end()
+        while k < len(body) and body[k] in ' \n':
+            k += 1
+        if k < len(body) and body[k] == '{':
+            depth = 0
+            for j in range(k, len(body)):
+                if body[j] == '{':
+                    depth += 1
+                elif body[j] == '}':
+                    depth -= 1
+                    if depth == 0:
+                        blocks[cmd] = body[k:j + 1]
+                        break
+        else:
+            blocks[cmd] = body[k:body.index(';', k) + 1]
+    return blocks
+
+
+def dispatched_commands(text):
+    """Commands the dispatcher actually matches."""
+    return set(dispatched_blocks(text))
+
+
+def find_drift(text=None):
+    """The absorbed ``check_protocol`` check: header command table vs
+    dispatcher. Returns human-readable problems (empty = in sync)."""
+    text = _read(text)
+    doc = documented_commands(text)
+    disp = dispatched_commands(text)
+    problems = []
+    for cmd in sorted(disp - doc):
+        problems.append('dispatched but not documented in the header '
+                        'comment: %s' % cmd)
+    for cmd in sorted(doc - disp - HANDSHAKE_ONLY):
+        problems.append('documented in the header comment but not '
+                        'dispatched: %s' % cmd)
+    if not doc:
+        problems.append('no documented commands found — the header '
+                        'comment table moved or changed format')
+    return problems
+
+
+def analyze(text=None):
+    """Full fence-coverage lint. Returns finding strings (empty =
+    clean)."""
+    text = _read(text)
+    findings = ['coord_service.cc: ' + p for p in find_drift(text)]
+    blocks = dispatched_blocks(text)
+    if not blocks:
+        return findings + ['coord_service.cc: could not locate the '
+                           'handle() dispatcher — the lint must be '
+                           'updated with the new layout']
+    classified = set(MUTATING) | set(ALLOWED_UNFENCED)
+    for cmd in sorted(set(blocks) - classified):
+        findings.append(
+            'coord_service.cc: dispatched command %s is not classified '
+            'in analysis/fence_lint.py (MUTATING or ALLOWED_UNFENCED) '
+            '— a new protocol command needs an explicit fencing '
+            'decision' % cmd)
+    for cmd in sorted(classified - set(blocks)):
+        findings.append(
+            'coord_service.cc: %s is classified in '
+            'analysis/fence_lint.py but no longer dispatched — stale '
+            'table entry' % cmd)
+    for cmd in sorted(set(MUTATING) & set(blocks)):
+        block = blocks[cmd]
+        if 'is_fenced_locked(' not in block and \
+                'is_fenced(' not in block:
+            findings.append(
+                'coord_service.cc: mutating command %s (%s) has no '
+                'fence check (is_fenced/is_fenced_locked)'
+                % (cmd, MUTATING[cmd]))
+        if 'kFencedErr' not in block:
+            findings.append(
+                'coord_service.cc: mutating command %s has no ERR '
+                'fenced reply path (kFencedErr)' % cmd)
+        if cmd in TENSOR_MUTATING and \
+                'reject_fenced_under_tensor_lock(' not in block:
+            findings.append(
+                'coord_service.cc: tensor-mutating command %s does not '
+                're-check the fence under the tensor lock '
+                '(reject_fenced_under_tensor_lock) — one in-flight '
+                'zombie frame could commit after its fence bump' % cmd)
+    hdr = header_fenced_commands(text)
+    if hdr is None:
+        findings.append(
+            'coord_service.cc: the header\'s writer-fencing paragraph '
+            '("every mutating command ... — X, Y — is rejected") was '
+            'not found — keep the enumeration, the lint pins it to '
+            'the MUTATING table')
+    else:
+        for cmd in sorted(set(MUTATING) - hdr):
+            findings.append(
+                'coord_service.cc: header writer-fencing paragraph '
+                'does not list mutating command %s' % cmd)
+        for cmd in sorted(hdr - set(MUTATING)):
+            findings.append(
+                'coord_service.cc: header writer-fencing paragraph '
+                'lists %s, which the lint does not classify as '
+                'mutating' % cmd)
+    return findings

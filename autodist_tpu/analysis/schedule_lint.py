@@ -1,0 +1,403 @@
+"""Schedule/plan consistency lint.
+
+The simulator prices the collective schedule
+``static_collective_schedule`` derives WITHOUT tracing; the runtime
+emits the schedule ``ExecutionPlan.sync_gradients`` derives WHILE
+tracing. The two are pinned equal by a traced test on one fixture
+(``tests/test_simulator.py``), but a predicate edited in only one of
+them can drift on configurations the fixture does not cover — the
+cost model would then price a schedule the runtime never runs (the
+array-redistribution paper's core complaint about layout-move
+programs, arXiv:2112.01075). This lint cross-checks the EMISSION
+PREDICATES at the AST level, so any asymmetric edit fails tier-1
+regardless of fixture coverage:
+
+- the bucket-fusion key (group, compressor, dtype, spec, hierarchical
+  knob) must have identical canonical components in both functions;
+- the fusable-predicate (which compressors may bucket-fuse, the
+  ``int8_bucket_fusable`` escape hatch) must admit the same set;
+- both sides must route the flat-vs-two-level choice through the ONE
+  shared ``choose_hierarchical`` decision with the same signature;
+- both sides must pack with ``pack_buckets`` and emit in the same
+  reverse-production order (the ``pending.sort`` key).
+
+Also here:
+
+- **reshard shape algebra** — ``reshard.plan_reshard`` layout moves
+  are verified element-preserving over a synthetic geometry sweep
+  (every src/dst layout pair across dividing, non-dividing and padded
+  shapes): each op kind's preconditions hold (``all_to_all`` only on
+  clean unpadded axis changes, etc.), the destination layout's shards
+  partition exactly the logical element set (no loss, no overlap
+  outside the pad), and zero-wire kinds claim zero wire;
+- the absorbed ``tools/check_wire_pricing.py`` drift check (compressor
+  registry vs ``cost_model._WIRE_ITEMSIZE``).
+"""
+import ast
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PLAN_SRC = os.path.join(REPO, 'autodist_tpu', 'parallel', 'plan.py')
+
+# -- AST cross-check of the two emission paths ----------------------------
+
+_CANON_RULES = (
+    (r'type\(plan\.compressor\)\.__name__', 'COMPRESSOR'),
+    (r'str\(np\.dtype\(var\.dtype\)\)', 'DTYPE'),
+    (r'str\(grad\.dtype\)', 'DTYPE'),
+    (r'plan\.group', 'GROUP'),
+    (r'plan\.spec', 'SPEC'),
+    (r'plan\.hierarchical', 'HIER'),
+)
+
+
+def _canon(src, assigns):
+    """Whitespace-free source with single-assignment names substituted
+    and the known equivalent spellings mapped to canonical tokens."""
+    def rules(s):
+        for pat, token in _CANON_RULES:
+            s = re.sub(pat, token, s)
+        return s
+
+    s = rules(re.sub(r'\s+', '', src))
+    for _ in range(4):   # bounded transitive substitution
+        out = s
+        for name, val in assigns.items():
+            out = re.sub(r'\b%s\b' % re.escape(name),
+                         lambda m, val=val: rules(val), out)
+        out = rules(out)
+        if out == s:
+            break
+        s = out
+    return s
+
+
+def _functions(tree):
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _assigns(fn, src):
+    """Simple single-target name assignments inside ``fn`` (for
+    substitution), by source text."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            seg = ast.get_source_segment(src, node.value)
+            if seg is not None:
+                name = node.targets[0].id
+                # only keep names assigned once (no reliable value
+                # otherwise)
+                out[name] = None if name in out \
+                    else re.sub(r'\s+', '', seg)
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _fusion_key(fn, src):
+    """The canonical components of ``key = (...)`` in ``fn``."""
+    assigns = _assigns(fn, src)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == 'key' \
+                and isinstance(node.value, ast.Tuple):
+            return tuple(
+                _canon(ast.get_source_segment(src, el), assigns)
+                for el in node.value.elts)
+    return None
+
+
+def _fusable_compressors(fn, src):
+    """The compressor classes the ``type(plan.compressor) in (...)``
+    membership test admits, plus whether ``int8_bucket_fusable`` is
+    consulted."""
+    admitted, int8 = None, False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], ast.In):
+            seg = re.sub(r'\s+', '',
+                         ast.get_source_segment(src, node.left) or '')
+            if seg == 'type(plan.compressor)' and \
+                    isinstance(node.comparators[0], ast.Tuple):
+                admitted = tuple(sorted(
+                    (ast.get_source_segment(src, el) or '')
+                    .split('.')[-1]
+                    for el in node.comparators[0].elts))
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, 'id', '')
+            if name == 'int8_bucket_fusable':
+                int8 = True
+    return admitted, int8
+
+
+def _calls_of(fn, src, callee):
+    """(positional arg count, sorted kwarg names) per call of
+    ``callee`` inside ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            getattr(f, 'id', '')
+        if name == callee:
+            out.append((len(node.args),
+                        tuple(sorted(k.arg for k in node.keywords
+                                     if k.arg))))
+    return out
+
+
+def _sort_key(fn, src):
+    """The canonical source of the ``pending.sort(key=...)`` lambda —
+    the reverse-production emission order both sides must share."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == 'sort':
+            for kw in node.keywords:
+                if kw.arg == 'key':
+                    return re.sub(
+                        r'\s+', '',
+                        ast.get_source_segment(src, kw.value) or '')
+    return None
+
+
+def check_emission_predicates(src=None):
+    """Cross-check sync_gradients vs static_collective_schedule."""
+    if src is None:
+        with open(PLAN_SRC) as f:
+            src = f.read()
+    findings = []
+    fns = _functions(ast.parse(src))
+    traced = fns.get('sync_gradients')
+    static = fns.get('static_collective_schedule')
+    hier = fns.get('_hier_groups_for')
+    if traced is None or static is None:
+        return ['plan.py: sync_gradients/static_collective_schedule '
+                'not found — update analysis/schedule_lint.py for the '
+                'new layout']
+    tk, sk = _fusion_key(traced, src), _fusion_key(static, src)
+    if tk is None or sk is None:
+        findings.append('plan.py: bucket-fusion key tuple not found in '
+                        '%s' % ('sync_gradients' if tk is None
+                                else 'static_collective_schedule'))
+    elif tk != sk:
+        findings.append(
+            'plan.py: bucket-fusion keys DRIFTED — sync_gradients '
+            'fuses on %s but static_collective_schedule on %s: the '
+            'simulator would price buckets the runtime never emits'
+            % (tk, sk))
+    (ta, ti), (sa, si) = (_fusable_compressors(traced, src),
+                          _fusable_compressors(static, src))
+    if ta is None or sa is None:
+        findings.append(
+            'plan.py: fusable-compressor membership test '
+            '(type(plan.compressor) in (...)) not found in %s'
+            % ('sync_gradients' if ta is None
+               else 'static_collective_schedule'))
+    elif (ta, ti) != (sa, si):
+        findings.append(
+            'plan.py: fusable predicates DRIFTED — sync_gradients '
+            'admits %s (int8 hatch: %s) but static_collective_schedule '
+            'admits %s (int8 hatch: %s)' % (ta, ti, sa, si))
+    traced_hier = _calls_of(hier, src, 'choose_hierarchical') \
+        if hier is not None else []
+    static_hier = _calls_of(static, src, 'choose_hierarchical')
+    if not traced_hier or not static_hier:
+        findings.append(
+            'plan.py: the flat-vs-hierarchical decision must route '
+            'through the ONE shared cost_model.choose_hierarchical on '
+            'both sides (traced call missing: %s, static call missing: '
+            '%s)' % (not traced_hier, not static_hier))
+    elif set(traced_hier) != set(static_hier):
+        findings.append(
+            'plan.py: choose_hierarchical call shapes DRIFTED — traced '
+            '%s vs static %s (same positional arity + kwargs required, '
+            'or the two sides price different decisions)'
+            % (traced_hier, static_hier))
+    for name, fn in (('sync_gradients', traced),
+                     ('static_collective_schedule', static)):
+        if not _calls_of(fn, src, 'pack_buckets'):
+            findings.append('plan.py: %s no longer packs via '
+                            'pack_buckets' % name)
+    tso, sso = _sort_key(traced, src), _sort_key(static, src)
+    if tso != sso:
+        findings.append(
+            'plan.py: bucket emission order DRIFTED — sync_gradients '
+            'sorts by %r, static_collective_schedule by %r' % (tso,
+                                                               sso))
+    return findings
+
+
+# -- reshard shape algebra ------------------------------------------------
+
+def _layouts_for(shape, n):
+    """Every layout an ExecutionPlan can place a var of ``shape`` in on
+    an ``n``-way data axis, with the plan's padding rule."""
+    outs = [{'sharded': False, 'axis': None, 'padded_dim': None,
+             'pad': 0}]
+    for axis, dim in enumerate(shape):
+        if dim < n:
+            continue   # the plan only shards axes >= n
+        padded = -(-dim // n) * n
+        outs.append({'sharded': True, 'axis': axis,
+                     'padded_dim': padded, 'pad': padded - dim})
+    return outs
+
+
+def _holdings(layout, shape, n, d):
+    """The logical flat-index set device ``d`` holds under ``layout``
+    (pad rows excluded)."""
+    import numpy as np
+    idx = np.arange(int(np.prod(shape))).reshape(shape)
+    if not layout['sharded']:
+        return set(idx.ravel().tolist())
+    ax, dim = layout['axis'], shape[layout['axis']]
+    rows = layout['padded_dim'] // n
+    lo, hi = d * rows, min((d + 1) * rows, dim)
+    if lo >= dim:
+        return set()
+    sl = [slice(None)] * len(shape)
+    sl[ax] = slice(lo, hi)
+    return set(idx[tuple(sl)].ravel().tolist())
+
+
+def _mock_plan(shape, layout, n):
+    from types import SimpleNamespace
+    import numpy as np
+    var = SimpleNamespace(shape=tuple(shape), dtype=np.float32)
+    vp = SimpleNamespace(var=var, state_sharded=layout['sharded'],
+                         shard_axis=layout['axis'] or 0,
+                         padded_dim=layout['padded_dim'],
+                         pad=layout['pad'])
+    return SimpleNamespace(var_plans={'v': vp}, num_replicas=n,
+                           cost_params=None)
+
+
+def check_reshard_algebra():
+    """Element-preservation + op-kind preconditions over the sweep."""
+    from autodist_tpu.parallel import reshard
+    from autodist_tpu.simulator.cost_model import CostModelParams
+    params = CostModelParams()
+    findings = []
+    shapes = [(8,), (8, 4), (9, 4), (8, 6), (6, 10)]
+    for n in (2, 4):
+        for shape in shapes:
+            for src in _layouts_for(shape, n):
+                for dst in _layouts_for(shape, n):
+                    old = _mock_plan(shape, src, n)
+                    new = _mock_plan(shape, dst, n)
+                    ops = reshard.plan_reshard(old, new, params=params)
+                    if len(ops) != 1:
+                        findings.append(
+                            'reshard: plan for %s n=%d covered %d ops '
+                            'for 1 var' % (shape, n, len(ops)))
+                        continue
+                    op = ops[0]
+                    ctx = 'reshard %s n=%d %s->%s (%s)' % (
+                        shape, n, _fmt(src), _fmt(dst), op.kind)
+                    findings.extend(_check_op(op, src, dst, shape, n,
+                                              ctx))
+    return findings
+
+
+def _fmt(layout):
+    if not layout['sharded']:
+        return 'repl'
+    return 'shard(ax%d,pad%d)' % (layout['axis'], layout['pad'])
+
+
+def _check_op(op, src, dst, shape, n, ctx):
+    problems = []
+    # kind preconditions (the shape algebra each lowering requires)
+    if op.kind == 'noop' and src != dst:
+        problems.append('%s: noop chosen for a layout CHANGE' % ctx)
+    if op.kind != 'noop' and src == dst:
+        problems.append('%s: layout unchanged but op is not noop' % ctx)
+    if op.kind == 'shard' and (src['sharded'] or not dst['sharded']):
+        problems.append('%s: shard requires replicated->sharded' % ctx)
+    if op.kind == 'all_gather' and (not src['sharded']
+                                    or dst['sharded']):
+        problems.append('%s: all_gather requires sharded->replicated'
+                        % ctx)
+    if op.kind == 'all_to_all':
+        if not (src['sharded'] and dst['sharded']):
+            problems.append('%s: all_to_all requires sharded->sharded'
+                            % ctx)
+        elif src['pad'] or dst['pad'] or src['axis'] == dst['axis']:
+            problems.append(
+                '%s: all_to_all chosen where its tiled split cannot '
+                'lower (pad %d->%d, axis %s->%s)'
+                % (ctx, src['pad'], dst['pad'], src['axis'],
+                   dst['axis']))
+    for layout, which in ((src, 'src'), (dst, 'dst')):
+        if layout['sharded']:
+            dim = shape[layout['axis']]
+            if layout['padded_dim'] % n:
+                problems.append('%s: %s padded_dim %d not divisible by '
+                                'n=%d' % (ctx, which,
+                                          layout['padded_dim'], n))
+            if layout['padded_dim'] - layout['pad'] != dim:
+                problems.append('%s: %s pad algebra broken (padded %d '
+                                '- pad %d != dim %d)'
+                                % (ctx, which, layout['padded_dim'],
+                                   layout['pad'], dim))
+    # element preservation: dst shards partition the logical set
+    import numpy as np
+    everything = set(range(int(np.prod(shape))))
+    union, total = set(), 0
+    for d in range(n):
+        h = _holdings(dst, shape, n, d)
+        union |= h
+        total += len(h)
+    if union != everything:
+        problems.append('%s: destination layout LOSES elements (%d of '
+                        '%d reachable)' % (ctx, len(union),
+                                           len(everything)))
+    if dst['sharded'] and total != len(everything):
+        problems.append('%s: destination shards overlap (%d held vs '
+                        '%d logical)' % (ctx, total, len(everything)))
+    if op.kind in ('noop', 'shard') and op.wire_bytes:
+        problems.append('%s: zero-wire kind claims %d wire bytes'
+                        % (ctx, op.wire_bytes))
+    if op.est_time_s < 0:
+        problems.append('%s: negative cost estimate' % ctx)
+    return problems
+
+
+# -- absorbed wire-pricing drift check ------------------------------------
+
+def check_wire_pricing():
+    """Compressor registry vs cost_model._WIRE_ITEMSIZE (a compressor
+    missing from the table silently prices as f32)."""
+    from autodist_tpu.parallel.compressor import _REGISTRY
+    from autodist_tpu.simulator.cost_model import _WIRE_ITEMSIZE
+    registry, priced = set(_REGISTRY), set(_WIRE_ITEMSIZE)
+    problems = []
+    for name in sorted(registry - priced):
+        problems.append('compressor registered but missing from '
+                        'cost_model._WIRE_ITEMSIZE (would silently '
+                        'price as f32): %s' % name)
+    for name in sorted(priced - registry):
+        problems.append('priced in cost_model._WIRE_ITEMSIZE but not '
+                        'in the compressor registry (stale entry): %s'
+                        % name)
+    if not registry:
+        problems.append('compressor registry is empty — the registry '
+                        'moved or the import graph broke')
+    return problems
+
+
+def analyze():
+    """Run all schedule/plan consistency checks. Returns finding
+    strings (empty = clean)."""
+    return (check_emission_predicates() + check_reshard_algebra() +
+            check_wire_pricing())

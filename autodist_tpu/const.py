@@ -310,6 +310,26 @@ class ENV(Enum):
     # BASELINE.md). Forwarded like the other tracing flags: divergent
     # HLO across SPMD hosts deadlocks.
     AUTODIST_DENSENET_DUS = (lambda v: (v == 'True' or v == '1'),)
+    # opt-in fused conv+BN Pallas kernel (models/vision.py; measured
+    # neutral-to-negative on v5e, BASELINE.md round-6 — kept for TPU
+    # generations where the BN passes bind) and its row-count ceiling
+    # (huge early-stage activations pay more in layout-conversion
+    # copies than the fused kernel saves). Forwarded like the other
+    # tracing flags: the kernel choice is part of the traced program,
+    # and divergent HLO across SPMD hosts deadlocks.
+    AUTODIST_FUSED_CONV = (lambda v: (v == 'True' or v == '1'),)
+    # row ceiling for the fused kernel; 0 = no limit (validated >= 0)
+    AUTODIST_FUSED_CONV_MAX_ROWS = \
+        (lambda v: _min_int('AUTODIST_FUSED_CONV_MAX_ROWS', v, 120000,
+                            lo=0),)
+    # pipeline-parallel 1F1B variant='auto' threshold (parallel/
+    # pipeline.py): stash (keep boundary activations) when the stash
+    # fits under this many MiB, else remat. The variant is part of the
+    # traced program, so every pipeline host must agree — forwarded
+    # like the other tracing flags.
+    AUTODIST_PP_STASH_LIMIT_MB = \
+        (lambda v: _positive_float('AUTODIST_PP_STASH_LIMIT_MB', v,
+                                   2048.0),)
 
     @property
     def val(self):

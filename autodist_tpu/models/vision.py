@@ -242,8 +242,8 @@ def _fused_conv_enabled():
     backward as single multi-output fusions, so there was less to save
     than the fusion names suggested). Full measurement notes in
     BASELINE.md."""
-    import os
-    return os.environ.get('AUTODIST_FUSED_CONV', '0') == '1'
+    from autodist_tpu.const import ENV
+    return ENV.AUTODIST_FUSED_CONV.val
 
 
 def _fused_max_rows():
@@ -252,9 +252,8 @@ def _fused_max_rows():
     pays layout-conversion copies at its boundaries; on the huge
     early-stage activations those copies outweigh the saved BN passes
     (measured on v5e), while late stages win. Tunable for benchmarking."""
-    import os
-    v = os.environ.get('AUTODIST_FUSED_CONV_MAX_ROWS', '')
-    return int(v) if v else 120000
+    from autodist_tpu.const import ENV
+    return ENV.AUTODIST_FUSED_CONV_MAX_ROWS.val
 
 
 def _fused_pointwise_ok(conv, x):

@@ -79,7 +79,7 @@
 // counter advances past the bound generation (a survivor or the
 // supervising coordinator declared this writer dead and bumped it),
 // every mutating command on the connection — SET, DEL, DELNS, INCR,
-// BSET, BADD, BSTEP — is rejected with `ERR fenced`, so a zombie can
+// BSET, BADD, BSADD, BSTEP — is rejected with `ERR fenced`, so a zombie can
 // never corrupt state after its replacement joins under a fresh
 // generation. Reads and waits stay open (a zombie observing the world
 // is harmless; only its writes are dangerous).

@@ -401,14 +401,13 @@ def _fused_1f1b(block_fn, stacked_params, x, axis_name, M, tail_fn,
     if variant not in ('auto', 'remat', 'stash'):
         raise ValueError('unknown 1F1B variant %r' % (variant,))
     if variant == 'auto':
-        import os
+        from autodist_tpu.const import ENV
         probe = jax.eval_shape(
             lambda v: head_fn(head_params, v),
             jax.ShapeDtypeStruct((mb,) + x.shape[1:],
                                  jnp.asarray(x).dtype))
         stash_bytes = M * int(np.prod(probe.shape)) * probe.dtype.itemsize
-        limit = float(os.environ.get('AUTODIST_PP_STASH_LIMIT_MB',
-                                     '2048')) * (1 << 20)
+        limit = ENV.AUTODIST_PP_STASH_LIMIT_MB.val * (1 << 20)
         variant = 'stash' if stash_bytes <= limit else 'remat'
 
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]

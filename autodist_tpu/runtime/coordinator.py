@@ -28,10 +28,22 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_HEARTBEAT_TIMEOUT,
                     ENV.AUTODIST_PS_ENDPOINTS, ENV.AUTODIST_PS_WIRE_DTYPE,
                     ENV.AUTODIST_PS_CHUNK_BYTES,
+                    # row-sparse push knobs: every loose worker must
+                    # classify deltas under the same threshold and
+                    # refresh cadence, or the fleet's wire behavior
+                    # (and its ps_stats audit) silently diverges
+                    ENV.AUTODIST_SPARSE_PUSH_MAX_FRAC,
+                    ENV.AUTODIST_SPARSE_FULL_REFRESH_EVERY,
                     # quantization block layout is part of the traced
                     # program (compressor) AND the PS frame format
                     ENV.AUTODIST_QUANT_BLOCK,
                     ENV.AUTODIST_S2D_STEM, ENV.AUTODIST_DENSENET_DUS,
+                    # kernel-choice + pipeline-variant tracing flags:
+                    # part of the traced program, and divergent HLO
+                    # across SPMD hosts deadlocks
+                    ENV.AUTODIST_FUSED_CONV,
+                    ENV.AUTODIST_FUSED_CONV_MAX_ROWS,
+                    ENV.AUTODIST_PP_STASH_LIMIT_MB,
                     # hierarchical node-group layout is part of the
                     # traced program (two-level collective schedules)
                     ENV.AUTODIST_HIERARCHY_NODES,

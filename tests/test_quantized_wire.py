@@ -21,13 +21,18 @@ from autodist_tpu.runtime import coord_client as cc
 HAVE_GXX = shutil.which('g++') is not None
 
 
-# -- wire-pricing drift check (tier-1 wiring of check_wire_pricing) ------
+# -- wire-pricing drift check (analysis/schedule_lint, shim:
+# tools/check_wire_pricing.py) -------------------------------------------
 
 def test_wire_itemsize_matches_compressor_registry():
     """A compressor missing from cost_model._WIRE_ITEMSIZE silently
     prices as f32 — the simulator could then never rank the tier the
-    compressor exists for."""
+    compressor exists for. Runs through the analyzer now; the
+    tools/check_wire_pricing.py shim must keep the documented CLI
+    entry alive."""
     import importlib.util
+    from autodist_tpu.analysis.schedule_lint import check_wire_pricing
+    assert check_wire_pricing() == []
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), 'tools', 'check_wire_pricing.py')
     spec = importlib.util.spec_from_file_location('check_wire_pricing',

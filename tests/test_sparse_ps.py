@@ -414,12 +414,17 @@ def test_ps_sparse_report_ratios():
     assert format_ps_sparse({}) == '(no sparse-plane counters)'
 
 
-# -- protocol-doc drift check (tools/check_protocol.py) -------------------
+# -- protocol-doc drift check (analysis/fence_lint, shim:
+# tools/check_protocol.py) ------------------------------------------------
 
 def test_protocol_header_matches_dispatch():
     """The coord_service header comment's command table must list
     exactly the dispatcher's commands (plus handshake-only AUTH) —
-    the two drifted once (BSTAT) before this check existed."""
+    the two drifted once (BSTAT) before this check existed. Runs
+    through the analyzer now; the tools/check_protocol.py shim must
+    keep the documented CLI invocation alive."""
+    from autodist_tpu.analysis import fence_lint
+    assert fence_lint.find_drift() == []
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, 'tools',
                                       'check_protocol.py')],
@@ -428,11 +433,7 @@ def test_protocol_header_matches_dispatch():
 
 
 def test_protocol_checker_catches_drift():
-    sys.path.insert(0, os.path.join(REPO, 'tools'))
-    try:
-        import check_protocol as cp
-    finally:
-        sys.path.pop(0)
+    from autodist_tpu.analysis import fence_lint as cp
     text = open(cp.SRC).read()
     assert not cp.find_drift(text)
     # an undocumented dispatched command must be flagged

@@ -1,0 +1,102 @@
+"""Repo-wide static analysis CLI — one entry over the four analyzers.
+
+    python tools/analyze.py --all            # everything, exit 0 = clean
+    python tools/analyze.py --fence --env    # just those analyzers
+    python tools/analyze.py --all --json     # machine-readable report
+
+Analyzers (autodist_tpu/analysis/, design notes in
+docs/design/static-analysis.md):
+
+  protocol   bounded model checking of the control-plane protocol
+             (HEAD orderings explore clean; the seeded historical bugs
+             must still re-derive as counterexamples)
+  fence      coord_service.cc dispatcher fence-coverage + header table
+             drift (absorbs tools/check_protocol.py)
+  env        AUTODIST_* env reads declared + worker knobs forwarded
+  schedule   sync_gradients vs static_collective_schedule emission
+             predicates, reshard shape algebra, wire-pricing drift
+             (absorbs tools/check_wire_pricing.py)
+
+Fast, no devices, no processes: wired into tier-1 via
+tests/test_analysis.py. CI/bench records can attach the --json report.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the schedule analyzer imports jax (through parallel/reshard.py);
+# keep the CLI runnable on devices-less hosts
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+
+def _analyzers():
+    from autodist_tpu.analysis import (env_lint, explore, fence_lint,
+                                       schedule_lint)
+    # cheap lints first; the model checker explores last
+    return (('fence', fence_lint.analyze),
+            ('env', env_lint.analyze),
+            ('schedule', schedule_lint.analyze),
+            ('protocol', explore.analyze))
+
+
+def run(names=None):
+    """Run the selected analyzers; returns the report dict."""
+    report = {'analyzers': {}, 'clean': True, 'findings': 0}
+    for name, fn in _analyzers():
+        if names is not None and name not in names:
+            continue
+        t0 = time.monotonic()
+        findings = fn()
+        report['analyzers'][name] = {
+            'findings': findings,
+            'elapsed_s': round(time.monotonic() - t0, 3)}
+        report['findings'] += len(findings)
+        if findings:
+            report['clean'] = False
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='repo-wide static analysis (exit 0 = zero '
+                    'findings)')
+    ap.add_argument('--all', action='store_true',
+                    help='run every analyzer')
+    ap.add_argument('--protocol', action='store_true',
+                    help='control-plane protocol model checker')
+    ap.add_argument('--fence', action='store_true',
+                    help='coord_service.cc fence-coverage lint')
+    ap.add_argument('--env', action='store_true',
+                    help='AUTODIST_* env-knob lint')
+    ap.add_argument('--schedule', action='store_true',
+                    help='schedule/plan consistency lint')
+    ap.add_argument('--json', action='store_true',
+                    help='print a machine-readable JSON report')
+    args = ap.parse_args(argv)
+    selected = {n for n in ('protocol', 'fence', 'env', 'schedule')
+                if getattr(args, n)}
+    if args.all or not selected:
+        selected = None
+    report = run(selected)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for name, rec in report['analyzers'].items():
+            status = 'clean' if not rec['findings'] else \
+                '%d finding(s)' % len(rec['findings'])
+            print('%-9s %s (%.2fs)' % (name, status, rec['elapsed_s']))
+            for f in rec['findings']:
+                print('  - ' + f.replace('\n', '\n    '))
+        print('analysis %s: %d finding(s)'
+              % ('CLEAN' if report['clean'] else 'FAILED',
+                 report['findings']))
+    return 0 if report['clean'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

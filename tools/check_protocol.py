@@ -1,60 +1,22 @@
-"""Coord-service protocol drift check.
+"""Coord-service protocol drift check — compatibility shim.
 
-Asserts that the command list documented in ``coord_service.cc``'s
-header comment matches the dispatcher's actual ``cmd == "..."`` set.
-The two have drifted before (BSTAT shipped undocumented), and the
-header is what operators and the client read — a drifted header is a
-protocol doc bug.
-
-Run:  python tools/check_protocol.py      (exit 0 = in sync)
-Wired into tier-1 via tests/test_sparse_ps.py.
+The check lives in :mod:`autodist_tpu.analysis.fence_lint` now (PR 9
+folded it into the static-analysis subsystem, generalized to full
+fence-coverage linting); this entry point keeps the documented
+``python tools/check_protocol.py`` invocation working and re-exports
+the original API (``SRC``, ``find_drift``, ``documented_commands``,
+``dispatched_commands``). Prefer ``python tools/analyze.py --fence``,
+which also verifies every mutating command is fence-checked.
 """
 import os
-import re
 import sys
 
-SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   'autodist_tpu', 'native', 'coord_service.cc')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-#: AUTH is consumed by the connection handshake (serve_conn) before any
-#: command reaches handle(); it belongs in the header but can never
-#: appear in the dispatcher.
-HANDSHAKE_ONLY = {'AUTH'}
-
-
-def documented_commands(text):
-    """Commands listed in the header comment's protocol table: lines of
-    the form ``//   CMD <args...> -> reply`` before the first
-    ``#include`` (continuation lines are indented further and reply
-    tokens never start a line)."""
-    header = text.split('#include', 1)[0]
-    return set(re.findall(r'^//   ([A-Z][A-Z0-9]*)\b', header, re.M))
-
-
-def dispatched_commands(text):
-    """Commands the dispatcher actually matches (``cmd == "..."``)."""
-    return set(re.findall(r'cmd == "([A-Z][A-Z0-9]*)"', text))
-
-
-def find_drift(text=None):
-    """Returns a list of human-readable drift problems (empty = in
-    sync)."""
-    if text is None:
-        with open(SRC) as f:
-            text = f.read()
-    doc = documented_commands(text)
-    disp = dispatched_commands(text)
-    problems = []
-    for cmd in sorted(disp - doc):
-        problems.append('dispatched but not documented in the header '
-                        'comment: %s' % cmd)
-    for cmd in sorted(doc - disp - HANDSHAKE_ONLY):
-        problems.append('documented in the header comment but not '
-                        'dispatched: %s' % cmd)
-    if not doc:
-        problems.append('no documented commands found — the header '
-                        'comment table moved or changed format')
-    return problems
+from autodist_tpu.analysis.fence_lint import (  # noqa: F401,E402
+    HANDSHAKE_ONLY, SRC, dispatched_commands, documented_commands,
+    find_drift)
 
 
 def main(argv=None):

@@ -1,43 +1,22 @@
-"""Compressor wire-pricing drift check.
+"""Compressor wire-pricing drift check — compatibility shim.
 
-Asserts that the cost model's ``_WIRE_ITEMSIZE`` table covers the
-compressor registry in ``autodist_tpu/parallel/compressor.py`` exactly.
-A compressor registered but missing from the table would silently price
-as f32 (``wire_bytes`` falls back to the raw itemsize), so the
-simulator could never rank the tier the compressor exists to enable —
-the same failure mode the protocol-drift check (check_protocol.py)
-guards against on the native wire.
-
-Run:  python tools/check_wire_pricing.py      (exit 0 = in sync)
-Wired into tier-1 via tests/test_quantized_wire.py.
+The check lives in :mod:`autodist_tpu.analysis.schedule_lint` now
+(PR 9 folded it into the static-analysis subsystem alongside the
+emission-predicate and reshard-algebra checks); this entry point keeps
+the documented ``python tools/check_wire_pricing.py`` invocation
+working and re-exports ``find_drift``. Prefer
+``python tools/analyze.py --schedule``.
 """
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def find_drift():
-    """Returns a list of human-readable drift problems (empty = in
-    sync)."""
-    from autodist_tpu.parallel.compressor import _REGISTRY
-    from autodist_tpu.simulator.cost_model import _WIRE_ITEMSIZE
-    registry = set(_REGISTRY)
-    priced = set(_WIRE_ITEMSIZE)
-    problems = []
-    for name in sorted(registry - priced):
-        problems.append('compressor registered but missing from '
-                        'cost_model._WIRE_ITEMSIZE (would silently '
-                        'price as f32): %s' % name)
-    for name in sorted(priced - registry):
-        problems.append('priced in cost_model._WIRE_ITEMSIZE but not '
-                        'in the compressor registry (stale entry): %s'
-                        % name)
-    if not registry:
-        problems.append('compressor registry is empty — the registry '
-                        'moved or the import graph broke')
-    return problems
+    from autodist_tpu.analysis.schedule_lint import check_wire_pricing
+    return check_wire_pricing()
 
 
 def main(argv=None):
