@@ -330,6 +330,36 @@ class ENV(Enum):
     AUTODIST_PP_STASH_LIMIT_MB = \
         (lambda v: _positive_float('AUTODIST_PP_STASH_LIMIT_MB', v,
                                    2048.0),)
+    # Unified telemetry plane (telemetry/, docs/design/
+    # observability.md): '1'/'True' enables the span/metrics registry
+    # — step/gate/pull/push spans in the session, per-RPC spans in the
+    # coord client, bucket-emission tags in the plan — and the
+    # cross-worker batch push to the PS telemetry namespace. Disabled
+    # (default) the API is zero-cost no-ops. Forwarded: a cohort
+    # timeline needs every worker emitting, not just the chief.
+    AUTODIST_TELEMETRY = (lambda v: (v == 'True' or v == '1'),)
+    # Where flight-recorder dumps and Chrome trace exports land
+    # (telemetry.flight.telemetry_dir; empty = <working dir>/telemetry).
+    AUTODIST_TELEMETRY_DIR = (lambda v: v if v else '',)
+    # Bound on every telemetry buffer (span/event rings, numeric
+    # series): telemetry must never grow without bound on a long run.
+    AUTODIST_TELEMETRY_MAX_SPANS = \
+        (lambda v: _min_int('AUTODIST_TELEMETRY_MAX_SPANS', v, 4096,
+                            lo=64),)
+    # How often (train steps) a loose-mode worker batch-pushes its
+    # drained span records to the <ns>/telemetry/ namespace; 0 = only
+    # at close. The push rides the background pipeline cadence, one
+    # vset per batch.
+    AUTODIST_TELEMETRY_PUSH_EVERY = \
+        (lambda v: _min_int('AUTODIST_TELEMETRY_PUSH_EVERY', v, 8,
+                            lo=0),)
+    # Ring capacity of the always-on crash flight recorder
+    # (telemetry/flight.py): the last N control-plane events (fence
+    # binds, epoch bumps, step publishes, exclusions, admit phases,
+    # replan stage/swap) dumped to disk on failure triggers.
+    AUTODIST_FLIGHT_RECORDER_EVENTS = \
+        (lambda v: _min_int('AUTODIST_FLIGHT_RECORDER_EVENTS', v, 512,
+                            lo=16),)
 
     @property
     def val(self):

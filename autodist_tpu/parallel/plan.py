@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from autodist_tpu.const import (AXIS_DATA, BUCKET_BYTES_PER_CHUNK,
                                 DEFAULT_CHUNK_SIZE, ENV)
 from autodist_tpu.kernels.partitioner import PartitionerConfig
+from autodist_tpu.telemetry import core as _telemetry
 from autodist_tpu.parallel import compressor as comp
 from autodist_tpu.strategy.base import (AllReduceSynchronizer,
                                         PSSynchronizer)
@@ -149,6 +150,27 @@ def pack_buckets(items, cap_bytes, max_vars=0):
     if cur:
         buckets.append(cur)
     return buckets
+
+
+def _emit_bucket_tag(entry):
+    """Telemetry tag for one emitted sync bucket (trace-time, so this
+    fires once per compiled step, not per executed step): schedule
+    shape (flat vs two-level), wire dtype and byte count — the
+    per-bucket emission evidence the cohort timeline pairs with the
+    measured step spans. No-op when telemetry is disabled."""
+    tel = _telemetry.get()
+    if not tel.enabled:
+        return
+    wire = {'Int8RingCompressor': 'i8',
+            'HorovodCompressor': 'bf16',
+            'HorovodCompressorEF': 'bf16'}.get(entry['compressor'],
+                                               entry['dtype'])
+    schedule = 'hier' if entry.get('hier') else 'flat'
+    tel.event('bucket_emit', kind=entry['kind'], group=entry['group'],
+              schedule=schedule, wire=wire, vars=entry['vars'],
+              bytes=entry['bytes'])
+    tel.count('plan/buckets_emitted')
+    tel.count('plan/bucket_%s' % schedule)
 
 
 def static_collective_schedule(strategy, graph_item, num_replicas,
@@ -625,6 +647,7 @@ class ExecutionPlan:
                 'compressor': None, 'dtype': str(g.dtype),
                 'spec': plan.spec, 'vars': 1, 'bytes': int(nbytes),
                 'members': [plan.var.name]})
+            _emit_bucket_tag(self.last_bucket_stats[-1])
             return scatter(g)
         split_axis = 0 if axis != 0 else 1
         dim = g.shape[split_axis]
@@ -638,6 +661,7 @@ class ExecutionPlan:
                 'spec': plan.spec, 'vars': 1,
                 'bytes': int(p.size * jnp.dtype(p.dtype).itemsize),
                 'members': [plan.var.name]})
+            _emit_bucket_tag(self.last_bucket_stats[-1])
         return jnp.concatenate([scatter(p) for p in parts],
                                axis=split_axis)
 
@@ -730,6 +754,7 @@ class ExecutionPlan:
                 'vars': len(bucket), 'bytes': nbytes,
                 'members': [sources[i].name for i in bucket],
                 'hier': len(groups) if groups else 0})
+            _emit_bucket_tag(self.last_bucket_stats[-1])
             if len(bucket) == 1 and groups is None:
                 i = bucket[0]
                 plan = self.plan_for(sources[i])

@@ -61,6 +61,7 @@ import time
 import numpy as np
 
 from autodist_tpu.const import DEFAULT_COORD_PORT, ENV
+from autodist_tpu.telemetry import core as _telemetry
 from autodist_tpu.utils import logging
 
 try:
@@ -414,6 +415,7 @@ def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
         except OSError as e:
             last = e
             RETRY_STATS['connect_retries'] += 1
+            _telemetry.get().count('coord/connect_retries')
             time.sleep(min(delay * (1.0 + random.uniform(-0.25, 0.25)),
                            max(0.0, deadline - time.time())))
             delay = min(delay * 2.0, 2.0)
@@ -461,6 +463,9 @@ class CoordClient:
         # background heartbeat thread) dial exactly what worked here —
         # the env address may differ (all-local runs rewrite to loopback)
         self.address = address
+        # per-RPC telemetry spans (command + payload bytes) when the
+        # plane is enabled; one attribute check per RPC when it is not
+        self._tel = _telemetry.get()
         self._sock = socket.create_connection(address, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b''
@@ -557,11 +562,24 @@ class CoordClient:
         else:
             self._sock.sendall(header)
 
+    @staticmethod
+    def _payload_nbytes(payload):
+        if payload is None:
+            return 0
+        if isinstance(payload, (list, tuple)):
+            return sum(len(b) for b in payload)
+        return len(payload)
+
     def _rpc(self, line, payload=None):
         """Send one request (header line + optional raw payload), read the
         reply header line."""
-        self._send_frame(line, payload)
-        return self._read_reply_line()
+        if not self._tel.enabled:
+            self._send_frame(line, payload)
+            return self._read_reply_line()
+        with self._tel.span('rpc', cmd=line.split(' ', 1)[0],
+                            bytes=self._payload_nbytes(payload)):
+            self._send_frame(line, payload)
+            return self._read_reply_line()
 
     def _pipelined(self, frames, on_reply, window=32):
         """Write request ``frames`` (``(token, line, payload)``) ahead of
@@ -572,14 +590,25 @@ class CoordClient:
         bounds how far the writer runs ahead so the two directions'
         socket buffers can never both fill (the classic pipelining
         deadlock)."""
-        outstanding = []
-        for token, line, payload in frames:
-            self._send_frame(line, payload)
-            outstanding.append(token)
-            if len(outstanding) >= window:
+        if self._tel.enabled:
+            frames = list(frames)
+            span = self._tel.span(
+                'rpc_batch',
+                cmd=frames[0][1].split(' ', 1)[0] if frames else '',
+                frames=len(frames),
+                bytes=sum(self._payload_nbytes(p)
+                          for _, _, p in frames))
+        else:
+            span = _telemetry._NULL_SPAN
+        with span:
+            outstanding = []
+            for token, line, payload in frames:
+                self._send_frame(line, payload)
+                outstanding.append(token)
+                if len(outstanding) >= window:
+                    on_reply(outstanding.pop(0))
+            while outstanding:
                 on_reply(outstanding.pop(0))
-        while outstanding:
-            on_reply(outstanding.pop(0))
 
     def _read_exact(self, nbytes):
         """Read exactly ``nbytes`` of reply payload (after a VAL header)."""

@@ -66,6 +66,15 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # ceiling identically (a joiner enforces it at its
                     # own admit claim)
                     ENV.AUTODIST_MAX_WORKERS,
+                    # telemetry plane: a cohort timeline needs every
+                    # worker emitting (and bounding buffers / pushing
+                    # batches / sizing the flight-recorder ring) under
+                    # the same knobs as the chief
+                    ENV.AUTODIST_TELEMETRY,
+                    ENV.AUTODIST_TELEMETRY_DIR,
+                    ENV.AUTODIST_TELEMETRY_MAX_SPANS,
+                    ENV.AUTODIST_TELEMETRY_PUSH_EVERY,
+                    ENV.AUTODIST_FLIGHT_RECORDER_EVENTS,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 
@@ -183,6 +192,10 @@ class WorkerSupervisor:
                         if self._is_shutting_down():
                             return
                         self.proc = self._spawn()
+                    from autodist_tpu import telemetry as _telemetry
+                    _telemetry.recorder().record(
+                        'worker_respawn', address=str(self.address),
+                        attempt=self.restarts)
                 except Exception as e:  # noqa: BLE001 - abort below
                     logging.error('respawn of worker %s failed: %s: %s',
                                   self.address, type(e).__name__, e)
@@ -358,6 +371,17 @@ class AutoscaleController:
                     logging.warning('autoscale scale_up to %d failed: '
                                     '%s', granted, rec['error'])
         self.decisions.append(rec)
+        from autodist_tpu import telemetry as _telemetry
+        if rec['action'] != 'skipped':
+            # only decisions that DID something (or failed trying)
+            # enter the bounded crash ring — a per-step no-op tick
+            # would otherwise scroll the post-mortem window the
+            # flight recorder exists to preserve
+            _telemetry.recorder().record(
+                'autoscale', action=rec['action'],
+                reason=rec.get('reason', ''), world=rec['world'],
+                desired=desired)
+        _telemetry.get().count('autoscale/%s' % rec['action'])
         if rec['action'] == 'scale_up':
             logging.info('autoscale: world %d -> %d (%s)',
                          rec['world'], rec['granted'], metrics)
@@ -635,6 +659,13 @@ class Coordinator:
             on_give_up=self._abort_chief,
             is_shutting_down=lambda: self._shutting_down).start()
         self.supervisors.append(sup)
+        from autodist_tpu import telemetry as _telemetry
+        _telemetry.recorder().record(
+            'worker_launch', worker='p%d' % pid, address=str(address),
+            policy=policy,
+            elastic_join=bool(extra_env and
+                              ENV.AUTODIST_ELASTIC_JOIN.name
+                              in extra_env))
         return sup
 
     def launch_clients(self):

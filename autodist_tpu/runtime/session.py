@@ -17,6 +17,7 @@ fused XLA program per distinct (fetches, feed-signature) pair:
   reference's in-graph replication + collective splicing equivalent).
 """
 import os
+from collections import deque as _deque
 
 import numpy as np
 
@@ -24,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu import telemetry as _telemetry
 from autodist_tpu.const import (AXIS_DATA, DEFAULT_CHECKPOINT_DIR,
                                 DEFAULT_TRACE_DIR, ENV)
 from autodist_tpu.frontend import graph as fe
@@ -184,6 +186,8 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
     world = coord.incr(world_key, 1)
     worker_id = world - 1
     worker = 'p%d' % worker_id
+    flight = _telemetry.recorder()
+    flight.record('admit_claim', worker=worker, world=world, ns=ns)
     if world - excluded_n > max_workers:
         # the cap read above and the claim are separate RPCs, so two
         # concurrent joiners can both pass the pre-check; the LAST
@@ -196,6 +200,7 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
         coord.incr('excluded/%s/%s' % (ns, worker), 1)
         coord.publish_step(worker, CLEAN_CLOSE_STEP,
                            prefix='%s/step/' % ns)
+        flight.record('admit_cap_retire', worker=worker, world=world)
         raise RuntimeError(
             'cannot join namespace %s: a concurrent join raced this '
             'claim past AUTODIST_MAX_WORKERS=%d (slot %s retired as '
@@ -207,6 +212,8 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
     fence_key = 'fence/%s/%s' % (ns, worker)
     generation = coord.incr(fence_key, 0)
     coord.fence(fence_key, generation)
+    flight.record('admit_fence_bind', worker=worker,
+                  generation=generation)
     floor = None
     for i in range(worker_id):
         step = coord.incr('%s/step/p%d' % (ns, i), 0)
@@ -226,7 +233,9 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
     # post-claim death must leave a VISIBLE member the exclusion
     # machinery can clean up, never an invisible counter it cannot
     epoch = coord.incr('%s/epoch' % ns, 1)
+    flight.record('admit_epoch_bump', worker=worker, epoch=epoch)
     coord.publish_step(worker, floor, prefix='%s/step/' % ns)
+    flight.record('admit_floor_publish', worker=worker, floor=floor)
     coord.heartbeat('%s/%s' % (ns, worker))
     wall = _time.monotonic() - t0
     logging.info(
@@ -236,6 +245,27 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
     return {'worker_id': worker_id, 'worker': worker, 'world': world,
             'generation': generation, 'adopted_step': floor,
             'epoch': epoch, 'admit_wall_s': wall}
+
+
+class _LazyDefault:
+    """Non-data descriptor: a class-level fallback a stub session
+    built via ``__new__`` (liveness/chaos tests exercise single
+    methods that way) resolves to the same process-wide value
+    ``__init__`` would have bound — and which any instance assignment
+    shadows. Deliberately NOT ``__getattr__``: that hook would convert
+    an ``AttributeError`` escaping any Session property getter into a
+    misleading ``AttributeError: <property name>``."""
+
+    def __init__(self, factory, name):
+        self._factory = factory
+        self._name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        val = self._factory()
+        obj.__dict__[self._name] = val
+        return val
 
 
 class Session:
@@ -253,6 +283,12 @@ class Session:
       (apply-per-push = reference staleness-mode accumulators,
       ps_synchronizer.py:387-458) gated by the bounded-staleness window.
     """
+
+    _tel = _LazyDefault(lambda: _telemetry.get(), '_tel')
+    _flight = _LazyDefault(lambda: _telemetry.recorder(), '_flight')
+    _step_walls = _LazyDefault(
+        lambda: _deque(maxlen=ENV.AUTODIST_TELEMETRY_MAX_SPANS.val),
+        '_step_walls')
 
     def __init__(self, graph_item, plan, cluster=None, coord=None):
         self._graph_item = graph_item
@@ -272,6 +308,16 @@ class Session:
         if self._loose and coord is None:
             raise RuntimeError('loose multi-process mode needs a coord '
                                'service client')
+        # telemetry handles + the run boundary BEFORE the elastic
+        # admit below: the admit handshake records flight events, and
+        # a run_start recorded after them would wipe the only live
+        # admit trail from the conformance replay (the checker resets
+        # per-run tracking at every boundary). Worker identity is
+        # attached once the admit has settled it.
+        self._tel = _telemetry.get()
+        self._flight = _telemetry.recorder()
+        self._flight.set_context(ns=self._ns)
+        self._flight.record('run_start', ns=self._ns)
         # -- elastic scale-UP: live JOIN into a running namespace ----------
         # AUTODIST_ELASTIC_JOIN marks this process as a joiner: it was
         # not part of the launch cohort, so its definitive identity is
@@ -296,6 +342,14 @@ class Session:
             self._joining = True
         self._num_workers = ENV.AUTODIST_NUM_PROCESSES.val
         self._worker_name = 'p%d' % ENV.AUTODIST_PROCESS_ID.val
+        self._flight.set_context(worker=self._worker_name)
+        # uniform per-step wall series: EVERY executed train step's
+        # run() wall time lands here, loose or SPMD, pipelined or
+        # serial (the t_step phase timing only covers loose-mode
+        # paths). Bounded ring; count/total survive in the telemetry
+        # series when enabled.
+        self._step_walls = _deque(
+            maxlen=ENV.AUTODIST_TELEMETRY_MAX_SPANS.val)
         # a joiner is never the chief: the chief seeded the PS and owns
         # the cohort rendezvous — a joiner consumes both
         self._is_chief = not ENV.AUTODIST_WORKER.val and \
@@ -350,6 +404,9 @@ class Session:
             self._fence_key = 'fence/%s' % self._key(self._worker_name)
             self._generation = coord.incr(self._fence_key, 0)
             coord.fence(self._fence_key, self._generation)
+            self._flight.set_context(generation=self._generation)
+            self._flight.record('fence_bind', worker=self._worker_name,
+                                generation=self._generation)
             # generation > 0 means a previous incarnation was declared
             # dead: this process is its supervised replacement and must
             # REJOIN (skip the init barrier nobody else attends, pull
@@ -742,6 +799,10 @@ class Session:
             if self._coord.incr('excluded/%s' % wkey, 0) > 0:
                 self._excluded.add(wkey)
         if self._key(self._worker_name) in self._excluded:
+            self._flight.record('self_excluded',
+                                worker=self._worker_name,
+                                epoch=self._epoch_seen)
+            self._flight.dump('self_excluded')
             raise RuntimeError(
                 'this worker (%s) was declared dead and excluded from '
                 'the run at epoch %d; its writes are fenced — exiting '
@@ -802,6 +863,9 @@ class Session:
                         entry['migration_staged'] = dict(
                             getattr(mig, 'cost', None) or {}) \
                             .get('builder', '')
+                        self._flight.record(
+                            'replan_staged', world=world,
+                            builder=entry['migration_staged'])
                         with self._replan_lock:
                             self._pending_replan = {
                                 'strategy': mig, 'world': world,
@@ -945,6 +1009,9 @@ class Session:
                 logging.warning(
                     'executed re-plan for world=%d refused: %s', world,
                     entry['migration_skipped'])
+                self._flight.record('replan_refused', world=world,
+                                    reason='shard_geometry')
+                self._flight.dump('replan_refusal')
                 return
             # device-side layout moves: vars + matching optimizer slots
             ops = reshard_mod.plan_reshard(old_plan, new_plan)
@@ -1018,6 +1085,10 @@ class Session:
                         logging.warning(
                             'executed re-plan for world=%d refused: '
                             '%s', world, entry['migration_skipped'])
+                        self._flight.record(
+                            'replan_refused', world=world,
+                            reason='endpoint_placement')
+                        self._flight.dump('replan_refusal')
                         return
             # ---- swap (everything above built on the side) ----
             self._plan = new_plan
@@ -1048,6 +1119,13 @@ class Session:
                 'strategy_id': compiled.id,
                 'reshard': reshard_mod.summarize(ops),
                 'wall_s': round(_time.perf_counter() - t0, 4)}
+            self._flight.record(
+                'replan_swap', world=world,
+                builder=entry['migration']['builder'],
+                wall_s=entry['migration']['wall_s'])
+            self._tel.record_span(
+                'replan_swap', t0, _time.perf_counter() - t0,
+                world=world, worker=self._worker_name)
             logging.info(
                 'executed re-plan for world=%d: migrated to %s in '
                 '%.3fs (%s); compiled steps dropped, state moved '
@@ -1061,6 +1139,9 @@ class Session:
             logging.warning(
                 'executed re-plan for world=%d failed (%s); keeping '
                 'the current plan', world, entry['migration_error'])
+            self._flight.record('replan_failed', world=world,
+                                error=entry['migration_error'])
+            self._flight.dump('replan_failure')
 
     def _exclude_peer(self, wkey, timeout):
         """Epoch-fenced exclusion of a dead peer. Every detector fences
@@ -1095,12 +1176,20 @@ class Session:
         coord_addr = tuple(getattr(self._coord, 'address', ()) or ())
         if coord_addr not in [tuple(a) for a in self._ps_addrs]:
             self._coord.incr(fkey, 1)
+        self._flight.record('fence_bump', worker=w,
+                            by=self._worker_name)
         claim = self._coord.incr('excluded/%s' % wkey, 1)
+        self._flight.record('exclude_claim', worker=w, claim=claim,
+                            by=self._worker_name)
         if claim == 1:
             from autodist_tpu.runtime.coord_client import CLEAN_CLOSE_STEP
             self._coord.publish_step(w, CLEAN_CLOSE_STEP,
                                      prefix=self._key('step/'))
+            self._flight.record('release', worker=w,
+                                by=self._worker_name)
             self._epoch_seen = self._coord.incr(self._key('epoch'), 1)
+            self._flight.record('epoch_bump', epoch=self._epoch_seen,
+                                by=self._worker_name)
             self._health['epoch_bumps'] += 1
             logging.warning(
                 'declared peer %s dead (no heartbeat for > %.0fs): '
@@ -1113,6 +1202,9 @@ class Session:
         self._excluded.add(wkey)
         self._health['exclusions'].append(
             {'worker': w, 'epoch': self._epoch_seen})
+        # an exclusion means somebody died — exactly when the last
+        # N control-plane events are worth keeping
+        self._flight.dump('exclusion:%s' % w)
 
     def _check_peers_alive(self):
         """Liveness + recovery policy while blocked on the staleness
@@ -1134,6 +1226,8 @@ class Session:
             self._health['epoch_bumps'] += epoch - self._epoch_seen
             self._epoch_seen = epoch
             self._refresh_membership()
+            self._flight.record('epoch_adopt', epoch=epoch,
+                                worker=self._worker_name)
             logging.warning('membership epoch advanced to %d: %d '
                             'active workers', epoch,
                             self._active_workers())
@@ -1362,6 +1456,83 @@ class Session:
                             for w in self._excluded))
         return out
 
+    # -- telemetry plane ---------------------------------------------------
+    @property
+    def step_wall_series(self):
+        """The uniform per-step wall series: ``run()``'s wall seconds
+        for every executed train step, EVERY mode (loose or SPMD,
+        pipelined or serial) — the series ``bench.py`` and the
+        telemetry snapshot read. Bounded ring
+        (``AUTODIST_TELEMETRY_MAX_SPANS``), oldest first."""
+        return list(self._step_walls)
+
+    def _maybe_push_telemetry(self, client, step, final=False):
+        """Batch-push this worker's drained span records to the
+        ``<ns>/telemetry/`` namespace every
+        ``AUTODIST_TELEMETRY_PUSH_EVERY`` train steps (``final=True``
+        forces the flush at close). Rides whatever connection the
+        caller holds — the pipeline thread's own client at depth 2, so
+        the push hides with the rest of the background wire work.
+        Never fatal: a telemetry push failing must not take down the
+        training it observes."""
+        if not self._tel.enabled or not self._loose:
+            return
+        every = ENV.AUTODIST_TELEMETRY_PUSH_EVERY.val
+        if not final and (not every or step % every):
+            return
+        try:
+            records = self._tel.drain_spans()
+            _telemetry.push_records(client, self._ns,
+                                    self._worker_name, records)
+        except Exception as e:  # noqa: BLE001 - advisory plane
+            logging.warning('telemetry batch push failed at step %d: '
+                            '%s: %s', step, type(e).__name__, e)
+
+    def cohort_telemetry(self):
+        """Chief-side cohort collection: every live member's pushed
+        span batches off the PS telemetry namespace, tagged per
+        worker and sorted on the shared wall axis. Loose mode only
+        (SPMD programs have no PS plane to aggregate over); returns
+        ``[]`` when telemetry is disabled or nothing was pushed."""
+        if not self._loose or self._coord is None:
+            return []
+        members = ['p%d' % i for i in range(self._world)]
+        return _telemetry.collect_records(self._coord, self._ns,
+                                          members)
+
+    def export_chrome_trace(self, path=None):
+        """Assemble the cohort timeline and write Chrome
+        ``trace_event`` JSON (chief-side; ``tools/trace_view.py`` is
+        the offline twin). Returns the path, or None when there was
+        nothing to export."""
+        import json as _json
+        records = self.cohort_telemetry()
+        # this worker's still-undrained spans join the export (the
+        # chief rarely pushes to itself)
+        for rec in self._tel.drain_spans():
+            rec.setdefault('worker', self._worker_name)
+            records.append(rec)
+        if not records:
+            return None
+        records.sort(key=lambda r: r.get('t0', 0.0))
+        # attribute control-plane instants to THIS process's row: ring
+        # events carry the SUBJECT worker (e.g. the excluded peer),
+        # not the actor
+        trace = _telemetry.chrome_trace(
+            records,
+            flight_events=[dict(e, worker_self=self._worker_name)
+                           for e in self._flight.events()])
+        if path is None:
+            path = os.path.join(_telemetry.telemetry_dir(),
+                                'trace-%s.json' % self._ns)
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, 'w') as f:
+            _json.dump(trace, f)
+        logging.info('telemetry: wrote cohort Chrome trace (%d events) '
+                     'to %s', len(trace['traceEvents']), path)
+        return path
+
     @property
     def ps_stats(self):
         """Loose-mode wire accounting: payload bytes moved and seconds
@@ -1559,7 +1730,42 @@ class Session:
 
     # -- run --------------------------------------------------------------
     def run(self, fetches, feed_dict=None, options=None):
-        """Execute fetches (reference WrappedSession.run, runner.py:117-132)."""
+        """Execute fetches (reference WrappedSession.run, runner.py:117-132).
+
+        Observability wrapper over :meth:`_run_fetches`: every executed
+        train step records one uniform wall-time sample
+        (:attr:`step_wall_series` + the ``step_wall_s`` telemetry
+        series) and, with telemetry enabled, a ``step`` span tagged
+        with its step id and worker. A
+        :class:`~autodist_tpu.runtime.coord_client.FencedWriteError`
+        surfacing here means this process is a zombie — the flight
+        recorder dumps before the error propagates (the evidence the
+        post-mortem needs is exactly what dies with the process).
+        """
+        import time as _time
+        from autodist_tpu.runtime.coord_client import FencedWriteError
+        t0 = _time.perf_counter()
+        before = self._step_count
+        try:
+            results = self._run_fetches(fetches, feed_dict, options)
+        except FencedWriteError:
+            self._flight.record('fenced_write_error',
+                                worker=self._worker_name,
+                                step=self._step_count)
+            self._flight.dump('fenced_write_error')
+            raise
+        if self._step_count > before:
+            wall = _time.perf_counter() - t0
+            self._step_walls.append(wall)
+            if self._tel.enabled:
+                self._tel.observe('step_wall_s', wall)
+                self._tel.gauge('step', self._step_count)
+                self._tel.record_span('step', t0, wall,
+                                      step=self._step_count,
+                                      worker=self._worker_name)
+        return results
+
+    def _run_fetches(self, fetches, feed_dict=None, options=None):
         if self._closed:
             raise RuntimeError('Session is closed')
         if ENV.AUTODIST_IS_TESTING.val and \
@@ -1628,10 +1834,15 @@ class Session:
                 # membership is a CALLABLE: policy=exclude can shrink
                 # the quorum while we are blocked inside this gate, and
                 # the wait must re-bound against the new epoch's count
-                self._coord.staleness_gate(
-                    self._step_count + 1, self._plan.gate_staleness,
-                    self._active_workers, prefix=self._key('step/'),
-                    failure_check=self._check_peers_alive)
+                with self._tel.span('staleness_gate',
+                                    step=self._step_count + 1,
+                                    worker=self._worker_name):
+                    self._coord.staleness_gate(
+                        self._step_count + 1,
+                        self._plan.gate_staleness,
+                        self._active_workers,
+                        prefix=self._key('step/'),
+                        failure_check=self._check_peers_alive)
                 # the gate guarantees every peer completed >= step -
                 # staleness; a prefetch taken while some peer was still
                 # below that bound may lack pushes the gate just
@@ -1780,9 +1991,12 @@ class Session:
             t0 = _time.perf_counter()
             self._push_ps_deltas(pulled, shared_values())
             self._coord.publish_step(worker, step, prefix=prefix)
+            self._flight.record('step_publish', worker=worker,
+                                step=step)
             with self._stats_lock:
                 self._ps_phase['exposed_wait_s'] += \
                     _time.perf_counter() - t0
+            self._maybe_push_telemetry(self._coord, step)
             return
 
         # snapshot the LIVE membership (launch quorum + joins, minus
@@ -1793,6 +2007,9 @@ class Session:
         def job(client):
             self._push_ps_deltas(pulled, shared_values())
             client.publish_step(worker, step, prefix=prefix)
+            self._flight.record('step_publish', worker=worker,
+                                step=step)
+            self._maybe_push_telemetry(client, step)
             # lower-bound what the pull-ahead below will observe: a
             # peer's published counter only advances AFTER its push
             # landed (push -> publish), so every push published by now
@@ -1900,6 +2117,7 @@ class Session:
         phase averages ``ps_stats['pipeline']`` divides by
         ``train_steps``."""
         import time as _time
+        t_fn = _time.perf_counter()
         variables = self._graph_item.graph.variables
         to_fetch = self._pull_to_fetch()
         fetched = None
@@ -1943,6 +2161,10 @@ class Session:
             if train:
                 self._ps_phase['pull_s'] += wire_s
                 self._ps_phase['exposed_wait_s'] += exposed_s
+        self._tel.record_span(
+            'pull_vars', t_fn, _time.perf_counter() - t_fn,
+            step=self._step_count + 1, worker=self._worker_name,
+            prefetched=exposed_s == 0.0 and wire_s > 0.0)
         return pulled
 
     def _shared_push_spec(self, norm):
@@ -2212,6 +2434,10 @@ class Session:
             ss['rows_pushed'] += rows_pushed
             ss['zero_push_skips'] += len(zero_skip)
             ss['dense_bytes_avoided'] += bytes_avoided
+        self._tel.record_span(
+            'push_deltas', t0, push_s, step=self._step_count,
+            worker=self._worker_name, bytes=wire_bytes,
+            sparse=len(sparse_rows), zero_skips=len(zero_skip))
         return push_s
 
     def _refresh_proxies(self, zero_skip, sparse_rows):
@@ -2429,6 +2655,28 @@ class Session:
                 logging.error(
                     'final background PS push failed in close(): %s: %s',
                     type(e).__name__, e)
+            # telemetry: flush this worker's final span batch, and on
+            # the chief assemble + export the cohort trace — BOTH
+            # before the purge quorum below can erase the run's
+            # telemetry namespace
+            if self._tel.enabled:
+                try:
+                    self._maybe_push_telemetry(
+                        self._coord, self._step_count, final=True)
+                    if self._is_chief:
+                        self.export_chrome_trace()
+                except Exception as e:  # noqa: BLE001 - advisory
+                    logging.warning('telemetry flush/export in close() '
+                                    'failed: %s: %s',
+                                    type(e).__name__, e)
+            self._flight.record('close', worker=self._worker_name,
+                                step=self._step_count,
+                                clean=drain_err is None)
+            if drain_err is not None:
+                # an unclean close IS a failure trigger: the PS copy is
+                # missing this worker's last step and the evidence of
+                # how dies with the process
+                self._flight.dump('unclean_close')
             # clean shutdown is not a crash: publish a done marker so
             # peers exclude us from dead-worker checks, and advance our
             # step counter past any reachable gate bound so a peer

@@ -1,0 +1,32 @@
+"""Unified telemetry plane: spans, metrics, cross-worker aggregation
+and the crash flight recorder.
+
+Three layers (docs/design/observability.md):
+
+- :mod:`~autodist_tpu.telemetry.core` — the low-overhead span/event
+  API and metrics registry (``AUTODIST_TELEMETRY`` gates it; disabled
+  = zero-cost no-ops);
+- :mod:`~autodist_tpu.telemetry.aggregate` — workers batch-push span
+  records to a ``telemetry/`` namespace over the existing PS tensor
+  wire; the chief assembles the cohort timeline and exports Chrome
+  ``trace_event`` JSON (``tools/trace_view.py``);
+- :mod:`~autodist_tpu.telemetry.flight` — the always-on bounded ring
+  of control-plane events, dumped on failure triggers and replayed
+  through the protocol model by
+  :mod:`autodist_tpu.analysis.conformance`.
+"""
+from autodist_tpu.telemetry.aggregate import (chrome_trace,
+                                              collect_records,
+                                              decode_records,
+                                              encode_records,
+                                              push_records,
+                                              step_timeline)
+from autodist_tpu.telemetry.core import Telemetry, get, reset
+from autodist_tpu.telemetry.flight import (FlightRecorder, load_dump,
+                                           recorder, telemetry_dir)
+from autodist_tpu.telemetry.flight import reset as reset_recorder
+
+__all__ = ['Telemetry', 'get', 'reset', 'FlightRecorder', 'recorder',
+           'reset_recorder', 'telemetry_dir', 'load_dump',
+           'encode_records', 'decode_records', 'push_records',
+           'collect_records', 'chrome_trace', 'step_timeline']

@@ -227,19 +227,31 @@ def ps_overlap_report(ps_stats):
     """
     pipe = (ps_stats or {}).get('pipeline') or {}
     if not pipe.get('train_steps'):
+        # zero-train-step snapshot (eval-only session, or a report
+        # taken before the first gated step landed): nothing to
+        # attribute — and nothing to divide by
         return {}
-    wire = pipe['pull_s'] + pipe['push_s']
-    exposed = min(pipe['exposed_wait_s'], wire)
+    # every field defaulted: a snapshot taken mid-replan (the plan
+    # swap clears compiled steps but the phase dict survives) or from
+    # an older/partial stats payload must degrade to zeros, not
+    # KeyError/ZeroDivisionError
+    pull_s = pipe.get('pull_s', 0.0)
+    push_s = pipe.get('push_s', 0.0)
+    wire = pull_s + push_s
+    exposed = min(pipe.get('exposed_wait_s', 0.0), wire)
+    overlap = pipe.get('overlap_frac')
+    if overlap is None:
+        overlap = (1.0 - exposed / wire) if wire > 0 else 0.0
     return {
-        'depth': pipe['depth'],
+        'depth': pipe.get('depth', 1),
         'train_steps': pipe['train_steps'],
-        'pull_s': pipe['pull_s'],
-        'step_s': pipe['step_s'],
-        'push_s': pipe['push_s'],
+        'pull_s': pull_s,
+        'step_s': pipe.get('step_s', 0.0),
+        'push_s': push_s,
         'wire_s': wire,
         'exposed_wire_s': exposed,
         'hidden_wire_s': max(0.0, wire - exposed),
-        'overlap_frac': pipe['overlap_frac'],
+        'overlap_frac': overlap,
     }
 
 
@@ -321,7 +333,13 @@ def health_report(health_stats, faultline=None, autoscale=None):
         'active_workers': hs.get('active_workers',
                                  hs.get('num_workers', 1)),
         'missed_beats': hs.get('missed_beats', 0),
-        'exclusions': list(hs.get('exclusions', ())),
+        # per-entry dict() snapshots: the session mutates these entry
+        # dicts in place from its background threads (a replan entry
+        # grows 'migration' fields when _execute_replan lands), and a
+        # report consumer iterating a half-joined entry mid-mutation
+        # must at worst see a stale copy, never a dict changing size
+        # under it
+        'exclusions': [dict(e) for e in hs.get('exclusions', ())],
         'rejoins': list(hs.get('rejoins', ())),
         'restarts_observed': len(hs.get('rejoins', ())),
         'recovery_wall_s': recovery,
@@ -329,10 +347,10 @@ def health_report(health_stats, faultline=None, autoscale=None):
         # elastic scale-up: joins this process OBSERVED (epoch at
         # admission), its own admit record (wall time) if it joined,
         # and the chief's predicted-vs-kept re-rank decisions
-        'joins': list(hs.get('joins', ())),
+        'joins': [dict(j) for j in hs.get('joins', ())],
         'admitted': dict(admitted) if admitted else None,
         'admit_wall_s': (admitted or {}).get('admit_wall_s', 0.0),
-        'replans': list(hs.get('replans', ())),
+        'replans': [dict(r) for r in hs.get('replans', ())],
         'autoscale': {
             'decisions': decisions,
             'taken': sum(1 for d in decisions
@@ -379,12 +397,19 @@ def format_health(report):
                      % (j.get('worker'), j.get('epoch', -1)))
     for r in report.get('replans', ()):
         if r.get('migrated'):
+            # a half-joined entry (snapshot taken between the
+            # migrated flag and the migration detail landing) degrades
+            # to placeholders, never a crash
             mig = r.get('migration') or {}
             status = ' [MIGRATED to %s in %.3fs via reshard %s]' % (
-                mig.get('builder', '?'), mig.get('wall_s', 0.0),
+                mig.get('builder', '?'), mig.get('wall_s') or 0.0,
                 (mig.get('reshard') or {}).get('kinds', {}))
         elif r.get('migration_error'):
             status = ' [migration failed: %s]' % r['migration_error']
+        elif r.get('migration_skipped'):
+            status = ' [migration skipped: %s]' % r['migration_skipped']
+        elif r.get('migration_staged'):
+            status = ' [migration staged: %s]' % r['migration_staged']
         else:
             status = ''
         lines.append('  replan @world=%d: predicted %s vs kept %s%s%s'

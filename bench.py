@@ -1445,6 +1445,243 @@ def _bench_elastic_inner(steps, join_at):
     }
 
 
+def bench_telemetry(steps=10):
+    """Telemetry-plane A/B + cohort trace + conformance (ISSUE 11
+    acceptance).
+
+    Runs the SAME 2-worker loose-mode workload (chief session + a
+    thread peer speaking the exact worker protocol) with
+    ``AUTODIST_TELEMETRY`` off and on, and records:
+
+    - the overhead A/B: per-step wall (median of the uniform
+      ``Session.step_wall_series``) for both runs and
+      ``overhead_frac`` — the budget is <= 2% on the CPU smoke;
+    - the Chrome trace export: the chief assembles the cohort timeline
+      (both workers' step spans, aligned on step ids) and writes
+      ``trace_event`` JSON (``tools/trace_view.py`` is the offline
+      twin);
+    - the metrics snapshot (counters / gauges / span aggregates /
+      the step-wall series) embedded in the record;
+    - flight-recorder conformance: the clean run's control-plane event
+      ring replays through the protocol-model invariants
+      (``analysis/conformance.py``) with zero findings.
+
+    Never raises: hosts without g++ degrade to ``{'error': ...}``.
+    """
+    try:
+        return _bench_telemetry_inner(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _telemetry_peer_loop(port, ns, steps, enabled):
+    """The simulated second worker: fence, barrier, publish all
+    ``steps`` steps AHEAD (the A/B measures the chief's step cost, so
+    its staleness gate must never block on peer pacing — gate-wait
+    aliasing against the peer's publish cadence swamped the
+    microseconds under test), push a per-step span batch when
+    telemetry is on, close cleanly."""
+    import time as _t
+
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.telemetry import push_records
+    c = CoordClient(('127.0.0.1', port))
+    try:
+        gen = c.incr('fence/%s/p1' % ns, 0)
+        c.fence('fence/%s/p1' % ns, gen)
+        c.heartbeat('%s/p1' % ns)
+        c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+        batch = []
+        t0 = _t.time()
+        for st in range(1, steps + 1):
+            c.publish_step('p1', st, prefix='%s/step/' % ns)
+            if enabled:
+                batch.append({'name': 'step', 't0': t0 + st * 1e-4,
+                              'dur': 1e-4,
+                              'tags': {'step': st, 'worker': 'p1'}})
+        c.heartbeat('%s/p1' % ns)
+        if enabled:
+            push_records(c, ns, 'p1', batch)
+        c.set('done/%s/p1' % ns, '1')
+        c.publish_step('p1', 1 << 30, prefix='%s/step/' % ns)
+    finally:
+        c.close()
+
+
+def _telemetry_run(port, steps, enabled, trace_path=None):
+    """One fresh 2-party loose run at the given telemetry setting.
+    Returns (per-step walls, metrics snapshot, trace path or None,
+    conformance findings over the chief's flight ring)."""
+    import threading
+    import time
+
+    import autodist_tpu as ad
+    from autodist_tpu import telemetry as telem
+    from autodist_tpu.analysis import conformance
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+
+    knobs = {'AUTODIST_TELEMETRY': '1' if enabled else None,
+             'AUTODIST_TELEMETRY_PUSH_EVERY': '2',
+             'AUTODIST_PEER_FAILURE_POLICY': 'fail'}
+    saved = {k: os.environ.get(k) for k in knobs}
+    for k, v in knobs.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    telem.reset()
+    telem.reset_recorder()
+    try:
+        with single_process_loose_env(port, depth=1):
+            autodist = ad.AutoDist(
+                resource_info={'nodes': [
+                    {'address': 'localhost', 'gpus': [0],
+                     'chief': True, 'network_bandwidth': 100}]},
+                strategy_builder=ad.strategy.PS(staleness=2))
+            rng = np.random.RandomState(0)
+            dim = 256
+            W0 = rng.randn(dim, 8).astype(np.float32)
+            feed = rng.randn(8, dim).astype(np.float32)
+            with autodist.scope():
+                x = ad.placeholder(shape=[None, dim],
+                                   dtype=np.float32, name='x')
+                W = ad.Variable(W0, name='W')
+                loss = ad.ops.reduce_mean(
+                    ad.ops.square(ad.ops.matmul(x, W)))
+                train_op = ad.optimizers.SGD(0.01).minimize(loss, [W])
+                autodist._build()   # sees 2 processes -> loose mode
+                ns = autodist._transformed[0].id
+                peer = threading.Thread(
+                    target=_telemetry_peer_loop,
+                    args=(port, ns, steps + 1, enabled), daemon=True)
+                peer.start()
+                sess = autodist.create_distributed_session()
+                sess.run(train_op, {x: feed})    # compile + warmup
+                for _ in range(steps):
+                    time.sleep(0.002)            # host tail
+                    sess.run(train_op, {x: feed})
+                walls = sess.step_wall_series[1:]   # drop the warmup
+                snapshot = telem.get().metrics_snapshot()
+                out_trace = None
+                if enabled:
+                    out_trace = sess.export_chrome_trace(trace_path)
+                findings = conformance.check_events(
+                    telem.recorder().events())
+                sess.close()
+                peer.join(timeout=30.0)
+        return walls, snapshot, out_trace, findings
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        telem.reset()
+
+
+def _bench_telemetry_inner(steps):
+    import json as _json
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    try:
+        # two INTERLEAVED rounds per leg: the legs are separate runs,
+        # so a transient co-tenant load spike during either one would
+        # otherwise masquerade as (or mask) the microseconds of span
+        # cost under test — per leg the better round's median stands
+        walls_off, _, _, _ = _telemetry_run(port, steps, enabled=False)
+        walls_on, snapshot, trace_path, findings = _telemetry_run(
+            port, steps, enabled=True)
+        walls_off2, _, _, _ = _telemetry_run(port, steps,
+                                             enabled=False)
+        walls_on2, _, _, _ = _telemetry_run(port, steps, enabled=True)
+    finally:
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    def leg(*rounds):
+        meds = [float(np.median(w)) for w in rounds if len(w)]
+        return min(meds) if meds else 0.0
+
+    off = leg(walls_off, walls_off2)
+    on = leg(walls_on, walls_on2)
+    off_med = float(np.median(list(walls_off) + list(walls_off2))) \
+        if walls_off else 0.0
+    on_med = float(np.median(list(walls_on) + list(walls_on2))) \
+        if walls_on else 0.0
+    trace_block = {'path': trace_path, 'events': 0, 'workers': []}
+    if trace_path and os.path.exists(trace_path):
+        with open(trace_path) as f:
+            tr = _json.load(f)
+        evs = tr.get('traceEvents', [])
+        step_spans = [e for e in evs if e.get('ph') == 'X'
+                      and e.get('name') == 'step']
+        trace_block = {
+            'path': trace_path,
+            'events': len(evs),
+            'workers': sorted({e['pid'] for e in step_spans}),
+            'step_span_count': len(step_spans),
+            # per-worker step spans aligned on step ids: every step
+            # span carries its step id tag
+            'steps_aligned': all('step' in (e.get('args') or {})
+                                 for e in step_spans)}
+    return {
+        'steps': steps,
+        'telemetry_off': {'per_step_wall_s': round(off, 6),
+                          'per_step_wall_median_s': round(off_med, 6)},
+        'telemetry_on': {
+            'per_step_wall_s': round(on, 6),
+            'per_step_wall_median_s': round(on_med, 6),
+            'spans': snapshot.get('spans', {}),
+            'counters': snapshot.get('counters', {}),
+            'step_wall_series': snapshot.get('series', {}).get(
+                'step_wall_s', {})},
+        'overhead_frac': round((on - off) / off, 4) if off > 0 else 0.0,
+        'overhead_budget_frac': 0.02,
+        'trace': trace_block,
+        'conformance': {'clean': not findings,
+                        'findings': list(findings)},
+    }
+
+
+def _sim_drift(simulator_block):
+    """The simulator predicted-vs-measured drift section for the
+    telemetry block: per measured candidate, predicted/measured step
+    time (the trajectory ``calibrate.py`` refits alpha-beta constants
+    against). Degrades to ``{}`` when the simulator block errored."""
+    cands = (simulator_block or {}).get('candidates') or []
+    rows = []
+    raw = []
+    for c in cands:
+        pred = c.get('predicted_step_time_s')
+        meas = c.get('measured_step_time_s')
+        if not pred or not meas or pred <= 0 or meas <= 0:
+            continue
+        raw.append(pred / meas)
+        rows.append({'name': c.get('name', '?'),
+                     'predicted_s': round(pred, 6),
+                     'measured_s': round(meas, 6),
+                     'ratio': round(pred / meas, 6)})
+    if not rows:
+        return {}
+    # worst over the UNROUNDED ratios: a tiny ratio rounds to 0.0 and
+    # its reciprocal would divide by zero
+    return {'candidates': rows,
+            'worst_ratio': round(max(max(raw), 1.0 / min(raw)), 4)}
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -1573,6 +1810,10 @@ def main():
         result['extra']['elastic'] = bench_elastic()
         result['extra']['quantized'] = bench_quantized()
         result['extra']['hierarchical'] = bench_hierarchical()
+        telemetry_rec = bench_telemetry()
+        telemetry_rec['sim_drift'] = _sim_drift(
+            result['extra']['simulator'])
+        result['extra']['telemetry'] = telemetry_rec
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -1592,6 +1833,10 @@ def main():
     elastic = bench_elastic()
     quantized = bench_quantized()
     hierarchical = bench_hierarchical()
+    telemetry_rec = bench_telemetry()
+    # simulator predicted-vs-measured drift rides the telemetry block:
+    # the observe-then-verify loop calibrate.py refits against
+    telemetry_rec['sim_drift'] = _sim_drift(simulator)
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -1613,6 +1858,7 @@ def main():
                 'elastic': elastic,
                 'quantized': quantized,
                 'hierarchical': hierarchical,
+                'telemetry': telemetry_rec,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -1669,7 +1915,8 @@ def main():
                       'sparse_ps': sparse_ps,
                       'elastic': elastic,
                       'quantized': quantized,
-                      'hierarchical': hierarchical},
+                      'hierarchical': hierarchical,
+                      'telemetry': telemetry_rec},
         }
     print(json.dumps(result))
 

@@ -311,3 +311,249 @@ def test_analyze_cli_selective():
     assert r.returncode == 0, r.stdout + r.stderr
     assert 'fence' in r.stdout and 'env' in r.stdout
     assert 'schedule' not in r.stdout.split('analysis')[0]
+
+
+# -- trace conformance (ISSUE 11: the dynamic twin) ------------------------
+
+def test_conformance_clean_exclusion_trace_passes():
+    """A correctly-ordered exclusion trace (fence bump -> claim ->
+    release -> epoch bump) with surviving-worker publishes replays
+    clean."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'fence_bind', 'worker': 'p0',
+         'generation': 0},
+        {'seq': 2, 'kind': 'step_publish', 'worker': 'p0', 'step': 1},
+        {'seq': 3, 'kind': 'step_publish', 'worker': 'p0', 'step': 2},
+        {'seq': 4, 'kind': 'fence_bump', 'worker': 'p1', 'by': 'p0'},
+        {'seq': 5, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1, 'by': 'p0'},
+        {'seq': 6, 'kind': 'release', 'worker': 'p1', 'by': 'p0'},
+        {'seq': 7, 'kind': 'epoch_bump', 'epoch': 1, 'by': 'p0'},
+        {'seq': 8, 'kind': 'step_publish', 'worker': 'p0', 'step': 3},
+        {'seq': 9, 'kind': 'close', 'worker': 'p0', 'clean': True},
+    ]
+    assert conformance.check_events(events) == []
+
+
+def test_conformance_rejects_zombie_write_and_resurrection():
+    """A step publish recorded for an excluded+released worker is a
+    committed zombie mutation: BOTH the fenced-write-commit and the
+    resurrection invariants fire, the latter through protocol_model's
+    own _check_resurrection."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'fence_bump', 'worker': 'p1'},
+        {'seq': 2, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1},
+        {'seq': 3, 'kind': 'release', 'worker': 'p1'},
+        {'seq': 4, 'kind': 'epoch_bump', 'epoch': 1},
+        {'seq': 5, 'kind': 'step_publish', 'worker': 'p1', 'step': 4},
+    ]
+    findings = conformance.check_events(events)
+    kinds = {f.split('[')[1].split(']')[0] for f in findings}
+    assert kinds == {'fenced-write-commit', 'resurrection'}
+    # the resurrection diagnosis is protocol_model's own wording
+    assert any('MINWAIT prefix-min' in f for f in findings)
+
+
+def test_conformance_rejects_unfenced_exclude():
+    """An exclusion claim with no prior fence bump is the
+    UNFENCED_EXCLUDE ordering the model checker counterexamples."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1},
+    ]
+    (finding,) = conformance.check_events(events)
+    assert 'unfenced-exclude' in finding
+    assert 'UNFENCED_EXCLUDE' in finding
+
+
+def test_conformance_rejects_admit_inversion_and_names_invariant():
+    """ISSUE 11 acceptance: a doctored out-of-order admit trace
+    (epoch bump after floor publish) is rejected with the violated
+    invariant named."""
+    from autodist_tpu.analysis import conformance
+    doctored = [
+        {'seq': 1, 'kind': 'admit_claim', 'worker': 'p2', 'world': 3},
+        {'seq': 2, 'kind': 'admit_fence_bind', 'worker': 'p2',
+         'generation': 0},
+        {'seq': 3, 'kind': 'admit_floor_publish', 'worker': 'p2',
+         'floor': 2},
+        {'seq': 4, 'kind': 'admit_epoch_bump', 'worker': 'p2',
+         'epoch': 1},
+    ]
+    (finding,) = conformance.check_events(doctored)
+    assert 'admit-inversion' in finding
+    assert 'no invisible frozen counter' in finding
+
+
+def test_conformance_truncated_ring_suppresses_absence_rules():
+    """The flight ring is bounded: when the oldest events scrolled off
+    (first retained seq > 1), absence-based rules must not fire — a
+    fence bump that predates the window is not a violation. Presence-
+    based rules (zombie write after an in-window claim) still do."""
+    from autodist_tpu.analysis import conformance
+    truncated = [
+        {'seq': 500, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1},
+        {'seq': 501, 'kind': 'admit_floor_publish', 'worker': 'p2',
+         'floor': 2},
+    ]
+    assert conformance.check_events(truncated) == []
+    # but a zombie publish after the in-window claim still fires
+    bad = truncated + [{'seq': 502, 'kind': 'step_publish',
+                        'worker': 'p1', 'step': 3}]
+    assert any('fenced-write-commit' in f
+               for f in conformance.check_events(bad))
+    # and an in-window admit claim anchors the inversion rule even on
+    # a truncated ring
+    anchored = truncated + [
+        {'seq': 503, 'kind': 'admit_claim', 'worker': 'p3',
+         'world': 4},
+        {'seq': 504, 'kind': 'admit_fence_bind', 'worker': 'p3',
+         'generation': 0},
+        {'seq': 505, 'kind': 'admit_floor_publish', 'worker': 'p3',
+         'floor': 2},
+    ]
+    assert any('admit-inversion' in f
+               for f in conformance.check_events(anchored))
+
+
+def test_conformance_run_start_resets_per_run_tracking():
+    """Back-to-back sessions share one process-wide ring: a run_start
+    boundary resets the checker's tracking, so run B's step 1 after
+    run A's step N is not a step regression (and A's exclusions do
+    not fence B's workers)."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'run_start', 'ns': 'a', 'worker': 'p0'},
+        {'seq': 2, 'kind': 'step_publish', 'worker': 'p0', 'step': 11},
+        {'seq': 3, 'kind': 'fence_bump', 'worker': 'p1'},
+        {'seq': 4, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1},
+        {'seq': 5, 'kind': 'release', 'worker': 'p1'},
+        {'seq': 6, 'kind': 'epoch_bump', 'epoch': 1},
+        {'seq': 7, 'kind': 'run_start', 'ns': 'b', 'worker': 'p0'},
+        {'seq': 8, 'kind': 'step_publish', 'worker': 'p0', 'step': 1},
+        {'seq': 9, 'kind': 'step_publish', 'worker': 'p1', 'step': 1},
+    ]
+    assert conformance.check_events(events) == []
+    # without the boundary the same tail IS a violation set
+    no_boundary = [e for e in events if e['kind'] != 'run_start']
+    assert conformance.check_events(no_boundary)
+    # a retained run_start ENDS truncation: everything after it is
+    # complete by construction, so absence-based rules re-arm
+    truncated_then_fresh = [
+        {'seq': 600, 'kind': 'step_publish', 'worker': 'p0',
+         'step': 9},
+        {'seq': 601, 'kind': 'run_start', 'ns': 'c', 'worker': 'p0'},
+        {'seq': 602, 'kind': 'exclude_claim', 'worker': 'p1',
+         'claim': 1},
+    ]
+    (f,) = conformance.check_events(truncated_then_fresh)
+    assert 'unfenced-exclude' in f
+
+
+def test_conformance_admit_trail_after_run_start_still_judged():
+    """Session records run_start BEFORE the elastic admit, so a real
+    joiner dump carries [run_start, admit_*...] — the boundary reset
+    must not swallow the only live admit trail (an inversion after
+    the boundary still fires)."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'run_start', 'ns': 'n'},
+        {'seq': 2, 'kind': 'admit_claim', 'worker': 'p2', 'world': 3},
+        {'seq': 3, 'kind': 'admit_fence_bind', 'worker': 'p2',
+         'generation': 0},
+        {'seq': 4, 'kind': 'admit_floor_publish', 'worker': 'p2',
+         'floor': 2},
+        {'seq': 5, 'kind': 'admit_epoch_bump', 'worker': 'p2',
+         'epoch': 1},
+    ]
+    (finding,) = conformance.check_events(events)
+    assert 'admit-inversion' in finding
+
+
+def test_conformance_malformed_event_is_a_finding_not_a_crash():
+    """A truncated/hand-edited event missing its worker field is
+    reported as malformed; the checker never dies with a traceback on
+    the evidence it exists to read."""
+    from autodist_tpu.analysis import conformance
+    events = [
+        {'seq': 1, 'kind': 'step_publish', 'step': 2},
+        {'seq': 2, 'kind': 'exclude_claim', 'claim': 1},
+    ]
+    findings = conformance.check_events(events)
+    assert len(findings) == 2
+    assert all('malformed-event' in f for f in findings)
+
+
+def test_conformance_monotonicity_rules():
+    from autodist_tpu.analysis import conformance
+    step_back = [
+        {'seq': 1, 'kind': 'step_publish', 'worker': 'p0', 'step': 5},
+        {'seq': 2, 'kind': 'step_publish', 'worker': 'p0', 'step': 3},
+    ]
+    (f,) = conformance.check_events(step_back)
+    assert 'step-regression' in f
+    epoch_back = [
+        {'seq': 1, 'kind': 'epoch_bump', 'epoch': 2},
+        {'seq': 2, 'kind': 'epoch_adopt', 'epoch': 1, 'worker': 'p0'},
+    ]
+    (f,) = conformance.check_events(epoch_back)
+    assert 'epoch-regression' in f
+
+
+def test_conformance_cli_dump_roundtrip(tmp_path):
+    """`tools/analyze.py --conformance` exits by findings and the
+    --json report carries them (the CI/chaos wiring)."""
+    clean = {'reason': 'exclusion:p1', 'context':
+             {'ns': 'n', 'worker': 'p0'},
+             'events': [
+                 {'seq': 1, 'kind': 'fence_bump', 'worker': 'p1'},
+                 {'seq': 2, 'kind': 'exclude_claim', 'worker': 'p1',
+                  'claim': 1},
+                 {'seq': 3, 'kind': 'release', 'worker': 'p1'},
+                 {'seq': 4, 'kind': 'epoch_bump', 'epoch': 1}]}
+    good = tmp_path / 'good.json'
+    good.write_text(json.dumps(clean))
+    bad_events = list(clean['events'])
+    bad_events.append({'seq': 5, 'kind': 'step_publish',
+                       'worker': 'p1', 'step': 2})
+    bad = tmp_path / 'bad.json'
+    bad.write_text(json.dumps(dict(clean, events=bad_events)))
+    env = {**os.environ, 'JAX_PLATFORMS': 'cpu'}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         '--conformance', str(good), '--json'],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)['clean'] is True
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         '--conformance', str(bad), '--json'],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report['clean'] is False
+    assert any('fenced-write-commit' in f for f in
+               report['analyzers']['conformance']['findings'])
+    # unreadable dump = a finding, not a crash
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         '--conformance', str(tmp_path / 'missing.json')],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1
+    assert 'unreadable' in r.stdout
+    # valid JSON that is NOT a dump (a span-record batch list — the
+    # other file type this toolchain produces) is also a finding
+    not_dump = tmp_path / 'records.json'
+    not_dump.write_text(json.dumps([{'name': 'step', 't0': 1.0}]))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         '--conformance', str(not_dump)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert 'unreadable' in r.stdout and 'Traceback' not in r.stderr

@@ -82,6 +82,22 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert h['flat']['hier_buckets'] == 0, h
     assert h['dcn_bytes_reduction'] >= 3.0, h
     assert h['state_max_abs_diff'] < 1e-5, h
+    # ISSUE 11: every record carries the telemetry block under its
+    # stable key — the on-vs-off overhead A/B, a multi-worker Chrome
+    # trace whose step spans align on step ids, a clean conformance
+    # replay and the simulator drift section
+    tl = extra['telemetry']
+    assert 'sim_drift' in tl, tl
+    if shutil.which('g++'):
+        assert 'error' not in tl, tl
+        assert tl['telemetry_off']['per_step_wall_s'] > 0
+        assert tl['telemetry_on']['per_step_wall_s'] > 0
+        assert tl['overhead_frac'] <= tl['overhead_budget_frac'], tl
+        tr = tl['trace']
+        assert tr['events'] > 0 and len(tr['workers']) >= 2, tr
+        assert tr['steps_aligned'], tr
+        assert tl['conformance']['clean'], tl['conformance']
+        assert tl['sim_drift'].get('candidates'), tl['sim_drift']
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

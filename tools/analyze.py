@@ -3,6 +3,8 @@
     python tools/analyze.py --all            # everything, exit 0 = clean
     python tools/analyze.py --fence --env    # just those analyzers
     python tools/analyze.py --all --json     # machine-readable report
+    python tools/analyze.py --conformance dump.json   # replay a
+             # flight-recorder dump through the protocol invariants
 
 Analyzers (autodist_tpu/analysis/, design notes in
 docs/design/static-analysis.md):
@@ -16,6 +18,12 @@ docs/design/static-analysis.md):
   schedule   sync_gradients vs static_collective_schedule emission
              predicates, reshard shape algebra, wire-pricing drift
              (absorbs tools/check_wire_pricing.py)
+
+``--conformance <dump>...`` is the dynamic twin (docs/design/
+observability.md): it replays the crash flight recorder's event trace
+through the SAME invariants the model checker proves on the abstract
+protocol (analysis/conformance.py), so chaos runs can assert the live
+system conforms.
 
 Fast, no devices, no processes: wired into tier-1 via
 tests/test_analysis.py. CI/bench records can attach the --json report.
@@ -77,7 +85,26 @@ def main(argv=None):
                     help='schedule/plan consistency lint')
     ap.add_argument('--json', action='store_true',
                     help='print a machine-readable JSON report')
+    ap.add_argument('--conformance', nargs='+', metavar='DUMP',
+                    help='replay flight-recorder dump(s) through the '
+                         'protocol-model invariants instead of the '
+                         'static analyzers')
     args = ap.parse_args(argv)
+    if args.conformance:
+        from autodist_tpu.analysis import conformance
+        findings = conformance.analyze(args.conformance)
+        report = {'analyzers': {'conformance': {
+            'findings': findings, 'elapsed_s': 0.0}},
+            'clean': not findings, 'findings': len(findings)}
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            for f in findings:
+                print('  - ' + f)
+            print('conformance %s: %d finding(s)'
+                  % ('CLEAN' if not findings else 'FAILED',
+                     len(findings)))
+        return 0 if not findings else 1
     selected = {n for n in ('protocol', 'fence', 'env', 'schedule')
                 if getattr(args, n)}
     if args.all or not selected:

@@ -1,0 +1,122 @@
+"""Cohort trace assembly + Chrome trace_event export CLI.
+
+    # offline: span-record batches / flight-recorder dumps -> one trace
+    python tools/trace_view.py records.json flightrec-*.json \\
+        --out trace.json
+
+    # live: collect a running (or just-finished, pre-purge) cohort's
+    # pushed telemetry batches off the coord service
+    python tools/trace_view.py --addr 127.0.0.1:14998 --ns <strategy id> \\
+        --workers 4 --out trace.json
+
+    # machine-readable summary (tier-1 smoke): worker/event counts and
+    # the per-step timeline (per-worker step spans aligned on step ids)
+    python tools/trace_view.py records.json --json
+
+Inputs are sniffed per file: a flight-recorder dump (``{'events':
+[...]}``) contributes instant events on a control-plane lane; a JSON
+list is span records (``telemetry.aggregate`` schema); a
+``{'traceEvents': ...}`` file is merged as-is. The output opens in
+``chrome://tracing`` / Perfetto with one process row per worker
+(``Session.export_chrome_trace`` is the in-process twin the chief runs
+at close).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_file(path, records, flight_events, premade):
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, list):
+        records.extend(payload)
+    elif isinstance(payload, dict) and 'events' in payload:
+        ctx = payload.get('context', {})
+        for ev in payload['events']:
+            ev.setdefault('worker_self', ctx.get('worker', 'p0'))
+            flight_events.append(ev)
+    elif isinstance(payload, dict) and 'traceEvents' in payload:
+        premade.extend(payload['traceEvents'])
+    else:
+        raise ValueError(
+            '%s: not a records list, flight-recorder dump or Chrome '
+            'trace' % path)
+
+
+def _collect_live(addr, ns, workers):
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.telemetry import collect_records
+    host, port = addr.rsplit(':', 1)
+    client = CoordClient((host, int(port)))
+    try:
+        return collect_records(client, ns,
+                               ['p%d' % i for i in range(workers)])
+    finally:
+        client.close()
+
+
+def main(argv=None):
+    from autodist_tpu.telemetry import chrome_trace, step_timeline
+    ap = argparse.ArgumentParser(
+        description='assemble cohort telemetry into a Chrome '
+                    'trace_event JSON')
+    ap.add_argument('paths', nargs='*',
+                    help='span-record batches, flight-recorder dumps '
+                         'or Chrome traces to merge')
+    ap.add_argument('--addr', help='coord service host:port for live '
+                                   'collection')
+    ap.add_argument('--ns', help='run namespace (strategy id) for '
+                                 'live collection')
+    ap.add_argument('--workers', type=int, default=2,
+                    help='worker count for live collection')
+    ap.add_argument('--out', help='write the Chrome trace JSON here')
+    ap.add_argument('--json', action='store_true',
+                    help='print a machine-readable summary')
+    args = ap.parse_args(argv)
+    records, flight_events, premade = [], [], []
+    for path in args.paths:
+        _load_file(path, records, flight_events, premade)
+    if args.addr and args.ns:
+        records.extend(_collect_live(args.addr, args.ns, args.workers))
+    if not (records or flight_events or premade):
+        print('trace_view: no input events', file=sys.stderr)
+        return 1
+    records.sort(key=lambda r: r.get('t0', 0.0))
+    trace = chrome_trace(records, flight_events=flight_events)
+    trace['traceEvents'].extend(premade)
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(trace, f)
+    timeline = step_timeline(records)
+    workers = sorted({r.get('worker', 'p0') for r in records})
+    summary = {
+        'workers': workers,
+        'span_records': len(records),
+        'flight_events': len(flight_events),
+        'trace_events': len(trace['traceEvents']),
+        'steps': {str(s): timeline[s] for s in sorted(timeline)},
+        'out': args.out or None,
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print('workers: %s' % ', '.join(workers))
+        print('%d span records, %d flight events -> %d trace events%s'
+              % (len(records), len(flight_events),
+                 len(trace['traceEvents']),
+                 ' -> %s' % args.out if args.out else ''))
+        for s in sorted(timeline):
+            row = '  step %-4d ' % s + '  '.join(
+                '%s %.1fms' % (w, dt * 1e3)
+                for w, dt in sorted(timeline[s].items()))
+            print(row)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
