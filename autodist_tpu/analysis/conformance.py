@@ -30,6 +30,13 @@ model checker enumerates interleavings over:
   fence bind (``unfenced-admit-write``).
 - **monotonicity** (``step-regression`` / ``epoch-regression``) — a
   worker's published steps and the membership epoch only move forward.
+- **slowdown pairing** (``unmatched-recovery``) — the performance
+  sentry's ``recovered`` event clears a prior ``slowdown`` verdict for
+  the same worker; a recovery with no preceding slowdown in the trace
+  is an inconsistent perf narrative. Absence-based like
+  ``unfenced-exclude``: suppressed on truncated rings (the slowdown
+  may simply have scrolled off the bound) and re-armed by a retained
+  ``run_start``.
 
 A conformant dump returns ``[]``; chaos tests assert real runs produce
 conformant traces, and ``tools/analyze.py --conformance <dump>`` is
@@ -59,6 +66,7 @@ def check_events(events):
     excluded = {}         # worker -> seq of the exclusion claim
     admit_seen = {}       # worker -> set of admit kinds already seen
     last_step = {}        # worker -> last published step
+    slowdown_open = {}    # worker -> seq of the active slowdown verdict
     last_epoch = 0
     # a ring whose first retained event is not seq 1 lost its oldest
     # events to the bound: absence-based rules (fence bump missing
@@ -75,7 +83,8 @@ def check_events(events):
     needs_worker = ('fence_bump', 'exclude_claim', 'release',
                     'admit_cap_retire', 'admit_claim',
                     'admit_fence_bind', 'admit_epoch_bump',
-                    'admit_floor_publish', 'step_publish')
+                    'admit_floor_publish', 'step_publish',
+                    'slowdown', 'recovered')
     for ev in events:
         kind = ev.get('kind', '')
         w = ev.get('worker')
@@ -92,6 +101,7 @@ def check_events(events):
             excluded = {}
             admit_seen = {}
             last_step = {}
+            slowdown_open = {}
             last_epoch = 0
             truncated = False
             continue
@@ -102,6 +112,25 @@ def check_events(events):
                 "event of kind %r carries no 'worker' field — the "
                 'trace is truncated or was edited; ordering '
                 'invariants cannot be attributed' % kind))
+            continue
+        if kind == 'slowdown':
+            # the performance sentry opened a verdict; nothing to
+            # judge beyond pairing — a slowdown is an observation, not
+            # a mutation
+            slowdown_open[w] = ev.get('seq')
+            continue
+        if kind == 'recovered':
+            if w not in slowdown_open and not truncated:
+                # absence-based, same rule as unfenced-exclude: only
+                # judged on an untruncated ring (the opening slowdown
+                # may have scrolled off the bound)
+                findings.append(_fmt(
+                    ev, 'unmatched-recovery',
+                    'recovered recorded with no prior slowdown verdict '
+                    'for %s — the perf narrative is inconsistent '
+                    '(monitor transitions are strictly slowdown -> '
+                    'recovered)' % w))
+            slowdown_open.pop(w, None)
             continue
         if kind in ('fence_bump', 'admit_fence_bind', 'fence_bind'):
             if kind == 'fence_bump':

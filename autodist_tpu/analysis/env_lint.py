@@ -73,6 +73,14 @@ FORWARD_EXEMPT = {
     'AUTODIST_FAULT_PLAN':
         'chaos-only: honored only where a FaultLine is explicitly '
         'installed; production sessions never read it',
+    'AUTODIST_STRAGGLER_POLICY':
+        'chief-side monitor verdict policy: workers only emit spans '
+        '(AUTODIST_TELEMETRY is forwarded) and never act on verdicts',
+    'AUTODIST_MONITOR_WINDOW':
+        'chief-side monitor statistics window; no worker reads it',
+    'AUTODIST_RECALIBRATE_EVERY':
+        "chief-side recalibration cadence; the refit constants feed "
+        "only the chief's re-rank",
 }
 
 _PY_READ = re.compile(

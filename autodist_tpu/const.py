@@ -356,10 +356,39 @@ class ENV(Enum):
     # Ring capacity of the always-on crash flight recorder
     # (telemetry/flight.py): the last N control-plane events (fence
     # binds, epoch bumps, step publishes, exclusions, admit phases,
-    # replan stage/swap) dumped to disk on failure triggers.
+    # replan stage/swap, slowdown/recovered verdicts) dumped to disk
+    # on failure triggers.
     AUTODIST_FLIGHT_RECORDER_EVENTS = \
         (lambda v: _min_int('AUTODIST_FLIGHT_RECORDER_EVENTS', v, 512,
                             lo=16),)
+    # Online performance sentry (telemetry/monitor.py): what the
+    # chief's CohortMonitor does with straggler verdicts.
+    #   off    - no monitor at all (statistics included)
+    #   warn   - verdicts logged + slowdown/recovered events recorded
+    #            in the flight recorder ring (default)
+    #   advise - additionally marks non-victim culprits as
+    #            exclude_candidate in health_report's perf section.
+    # Detection is observability, NEVER actuation: the PR 4 peer-
+    # failure policy machinery stays the sole actuator — this knob
+    # deliberately stops at 'advise'.
+    AUTODIST_STRAGGLER_POLICY = \
+        (lambda v: _choice('AUTODIST_STRAGGLER_POLICY', v, 'warn',
+                           ('off', 'warn', 'advise')),)
+    # Rolling-window sample bound (train steps) of the monitor's
+    # per-worker robust statistics (median/MAD of step wall and the
+    # per-phase splits). Detection itself reads a short recent-median
+    # inside this window so a straggler surfaces within a few steps of
+    # onset, not half a window later.
+    AUTODIST_MONITOR_WINDOW = \
+        (lambda v: _min_int('AUTODIST_MONITOR_WINDOW', v, 32, lo=4),)
+    # Continuous cost-model recalibration cadence (train steps): every
+    # N steps the chief refits the link alpha-beta constants from live
+    # telemetry (data-plane RPC spans as point-to-point samples) and
+    # hands the measured constants to _replan_for_world's re-rank.
+    # 0 disables (default) — re-ranks then price with analytic
+    # constants, exactly the pre-monitor behavior.
+    AUTODIST_RECALIBRATE_EVERY = \
+        (lambda v: _min_int('AUTODIST_RECALIBRATE_EVERY', v, 0, lo=0),)
 
     @property
     def val(self):

@@ -136,6 +136,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -1194,6 +1195,16 @@ bool read_line(int fd, std::string* buf, std::string* line) {
 }
 
 void serve_conn(int fd) {
+  // TCP_NODELAY on every accepted connection: replies are written as
+  // two send() calls (header line, then payload) — under Nagle the
+  // payload segment waits for the client's ACK of the header, and the
+  // client's delayed ACK turns EVERY payload-bearing reply (BGET and
+  // friends) into a ~40ms stall on loopback. The client side has set
+  // this since PR 1; the accept side was the missing half.
+  {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
   std::string buf;
   char chunk[1 << 16];
   ConnState conn;

@@ -280,7 +280,8 @@ class AutoscaleController:
     """
 
     def __init__(self, policy, scale_up, current_world,
-                 max_workers=None, live_world=None):
+                 max_workers=None, live_world=None,
+                 metrics_source=None):
         self._policy = policy
         self._scale_up = scale_up
         self.world = current_world
@@ -292,6 +293,13 @@ class AutoscaleController:
         # after churn, and a launched-but-refused joiner would count
         # as phantom capacity permanently
         self._live_world = live_world
+        # optional zero-arg callable returning sampled metrics merged
+        # under each tick's explicit metrics (explicit wins). The
+        # production wiring is the chief's CohortMonitor.metrics —
+        # that is what puts a COMPUTED step_time_s behind the built-in
+        # policy's step_time_target_s signal instead of a stub the
+        # caller had to fabricate.
+        self._metrics_source = metrics_source
         self.decisions = []
 
     @property
@@ -313,8 +321,20 @@ class AutoscaleController:
                    if d['action'] == 'failed')
 
     def tick(self, metrics=None):
-        """One autoscale evaluation; returns the decision record."""
-        metrics = dict(metrics or {})
+        """One autoscale evaluation; returns the decision record.
+        ``metrics`` (optional) overlays the ``metrics_source`` sample —
+        callers can still force a signal for a single tick."""
+        explicit = dict(metrics or {})
+        metrics = {}
+        if self._metrics_source is not None:
+            try:
+                metrics = dict(self._metrics_source() or {})
+            except Exception as e:  # noqa: BLE001 - the sampled
+                # signal is advisory; a monitor hiccup must not kill
+                # the autoscale loop
+                logging.warning('autoscale metrics_source failed: '
+                                '%s: %s', type(e).__name__, e)
+        metrics.update(explicit)
         if self._live_world is not None:
             try:
                 live = self._live_world()
@@ -757,18 +777,23 @@ class Coordinator:
         except OSError:
             return fallback
 
-    def autoscaler(self, policy):
+    def autoscaler(self, policy, metrics_source=None):
         """An :class:`AutoscaleController` wired to this coordinator:
         its decisions execute through :meth:`scale_up`, starting from
         the worker ordinals this coordinator has already issued (NOT
         the launch node count — a manual ``scale_up`` call before the
-        controller exists must not read as phantom headroom)."""
+        controller exists must not read as phantom headroom).
+        ``metrics_source`` feeds each tick's sampled metrics — pass
+        the chief session's ``monitor.metrics`` so the built-in
+        ``step_time_target_s`` policy runs on the cohort's measured
+        step time instead of caller-fabricated numbers."""
         fallback = getattr(self, '_next_pid',
                            len(list(self._resource_spec.nodes)))
         return AutoscaleController(
             policy, self.scale_up, current_world=fallback,
             live_world=lambda: self._live_world_estimate(
-                getattr(self, '_next_pid', fallback)))
+                getattr(self, '_next_pid', fallback)),
+            metrics_source=metrics_source)
 
     def join(self):
         for s in self.supervisors:

@@ -289,6 +289,11 @@ class Session:
     _step_walls = _LazyDefault(
         lambda: _deque(maxlen=ENV.AUTODIST_TELEMETRY_MAX_SPANS.val),
         '_step_walls')
+    # stub sessions (__new__) have no sentry and no telemetry push
+    # lane; real ones bind in __init__
+    _monitor = None
+    _tel_pipe = None
+    _tel_push_handle = None
 
     def __init__(self, graph_item, plan, cluster=None, coord=None):
         self._graph_item = graph_item
@@ -425,6 +430,14 @@ class Session:
                 # joiners launched before the run they join, which the
                 # scale-up paths never do.
                 self._coord.delete(self._key('session/init-done'))
+                # likewise a previous run's telemetry namespace (batch
+                # keys + the atomic batch counters): the close-side
+                # purge below covers the normal path, but a crashed
+                # prior run whose close never ran would replay its
+                # stale batches into THIS run's cohort trace — the
+                # per-worker batch counter would hand the collector
+                # sequence numbers that decode to the dead run's spans
+                self._coord.delete_namespace(self._key('telemetry/'))
                 # seed the elastic world counter to the launch quorum
                 # BEFORE the init rendezvous (admits wait for the
                 # init-done marker, so no join can race this). A stale
@@ -448,6 +461,30 @@ class Session:
                 # the admit handshake already published this floor; the
                 # session resumes counting from it
                 self._step_count = self._admit['adopted_step']
+        # -- online performance sentry (chief-side) --------------------
+        # The CohortMonitor streams the cohort's span batches off the
+        # telemetry namespace (poll rides the push cadence), issues
+        # straggler verdicts with phase attribution, records
+        # slowdown/recovered flight events, and — on the
+        # AUTODIST_RECALIBRATE_EVERY cadence — refits the cost model's
+        # link constants from live traffic for _replan_for_world's
+        # re-rank. Chief-only (verdicts need the whole cohort's spans,
+        # which only the chief collects) and telemetry-gated: with
+        # AUTODIST_TELEMETRY off nobody pushes batches to consume.
+        self._monitor = None
+        self._recalibrate_every = ENV.AUTODIST_RECALIBRATE_EVERY.val
+        self._last_recalibrate_step = 0
+        if self._loose and self._is_chief and self._tel.enabled and \
+                ENV.AUTODIST_STRAGGLER_POLICY.val != 'off':
+            from autodist_tpu.telemetry.monitor import CohortMonitor
+            self._monitor = CohortMonitor(
+                client=self._coord, ns=self._ns,
+                workers=lambda: ['p%d' % i
+                                 for i in self._live_members()],
+                flight=self._flight,
+                # our own batches are tapped at drain time, never
+                # fetched back off the wire (ingest_local)
+                local_worker=self._worker_name)
         # chief-side auto-checkpoint backstop: with restarts in play the
         # PS state is authoritative, but a periodic chief snapshot
         # bounds the blast radius of losing the PS itself
@@ -530,6 +567,13 @@ class Session:
         self._inflight = None
         self._stashed_prefetch = None
         self._pipeline_depth = 1
+        # telemetry batch pushes ride their OWN background lane (one
+        # TransferPool worker, own fenced connection, created lazily
+        # on the first push): a telemetry batch never belongs on the
+        # step's critical path — at depth 1 the serial data plane
+        # would otherwise pay a full wire round trip per push cadence
+        self._tel_pipe = None
+        self._tel_push_handle = None
         self._ps_phase = {'pull_s': 0.0, 'push_s': 0.0, 'step_s': 0.0,
                           'exposed_wait_s': 0.0, 'train_steps': 0,
                           'discarded_prefetches': 0}
@@ -831,8 +875,24 @@ class Session:
                 entry['skipped'] = 'no resource spec on the cluster'
             else:
                 from autodist_tpu.strategy.builders import AutoStrategy
+                # continuous calibration closes the loop here: when the
+                # monitor has refit the link constants from live
+                # traffic, the re-rank prices with MEASURED, not
+                # analytic, alpha-beta — and the audit entry records
+                # which constants priced it
+                params = None
+                if self._monitor is not None:
+                    params = self._monitor.calibrated_params()
+                entry['cost_constants'] = \
+                    'measured' if params is not None else 'analytic'
+                if params is not None:
+                    a, b = params.link(
+                        cross_node=rs.topology.multi_node)
+                    entry['cost_alpha_beta'] = {
+                        'alpha_s': a, 'beta_s_per_byte': b}
                 auto = AutoStrategy(
-                    num_replicas=world * max(1, self._plan.local_replicas))
+                    num_replicas=world * max(1, self._plan.local_replicas),
+                    cost_params=params)
                 best = auto.build(self._graph_item, rs)
                 cost = dict(getattr(best, 'cost', None) or {})
                 entry['predicted'] = cost.get('builder', '')
@@ -855,7 +915,8 @@ class Session:
                     if execute else
                     ' (AUTODIST_EXECUTE_REPLAN off: audit only)')
                 if execute:
-                    mig = self._build_migratable_strategy(world, rs)
+                    mig = self._build_migratable_strategy(world, rs,
+                                                          params=params)
                     if mig is None:
                         entry['migration_skipped'] = \
                             'no PS-family candidate for this strategy'
@@ -876,7 +937,7 @@ class Session:
                             world, entry['error'])
         self._health['replans'].append(entry)
 
-    def _build_migratable_strategy(self, world, rs):
+    def _build_migratable_strategy(self, world, rs, params=None):
         """Best strategy this LIVE session can actually migrate to: the
         PS family with the current strategy's relaxed-consistency flags
         preserved (sync / staleness / shared_optimizer / proxy), so the
@@ -914,7 +975,7 @@ class Session:
              lambda: b.UnevenPartitionedPS(**flags)),
         ]
         feasible, _ = search.rank(
-            self._graph_item, rs, candidates=cands,
+            self._graph_item, rs, candidates=cands, params=params,
             num_replicas=world * max(1, self._plan.local_replicas))
         names = list(self._graph_item.graph.variables)
         for cand in feasible:
@@ -1454,6 +1515,12 @@ class Session:
             active_workers=self._active_workers(),
             excluded=sorted(w.rsplit('/', 1)[-1]
                             for w in self._excluded))
+        if self._monitor is not None:
+            # the perf section: rolling cohort stats, active verdicts
+            # (exclude candidates under policy=advise), the
+            # slowdown/recovered audit and the recalibration
+            # trajectory — health_report/format_health render it
+            out['perf'] = self._monitor.snapshot()
         return out
 
     # -- telemetry plane ---------------------------------------------------
@@ -1466,15 +1533,32 @@ class Session:
         (``AUTODIST_TELEMETRY_MAX_SPANS``), oldest first."""
         return list(self._step_walls)
 
+    def _join_tel_push(self):
+        """Join the previous background telemetry push (keeps pushes
+        FIFO-ordered on the lane and surfaces — logged, never raised —
+        any error it hit)."""
+        handle, self._tel_push_handle = self._tel_push_handle, None
+        if handle is None:
+            return
+        try:
+            handle.result()
+        except Exception as e:  # noqa: BLE001 - advisory plane
+            logging.warning('background telemetry batch push failed: '
+                            '%s: %s', type(e).__name__, e)
+
     def _maybe_push_telemetry(self, client, step, final=False):
         """Batch-push this worker's drained span records to the
         ``<ns>/telemetry/`` namespace every
-        ``AUTODIST_TELEMETRY_PUSH_EVERY`` train steps (``final=True``
-        forces the flush at close). Rides whatever connection the
-        caller holds — the pipeline thread's own client at depth 2, so
-        the push hides with the rest of the background wire work.
-        Never fatal: a telemetry push failing must not take down the
-        training it observes."""
+        ``AUTODIST_TELEMETRY_PUSH_EVERY`` train steps. Steady-state
+        pushes ride a dedicated background lane (one lazily-created
+        ``TransferPool`` worker with its own fenced connection): a
+        telemetry batch never belongs on the step's critical path —
+        at depth 1 the serial data plane would otherwise pay a full
+        wire round trip per cadence. ``final=True`` (the close-time
+        flush) joins the lane and pushes synchronously on the
+        caller's client so nothing is in flight when the chief
+        collects and purges. Never fatal: a telemetry push failing
+        must not take down the training it observes."""
         if not self._tel.enabled or not self._loose:
             return
         every = ENV.AUTODIST_TELEMETRY_PUSH_EVERY.val
@@ -1482,8 +1566,28 @@ class Session:
             return
         try:
             records = self._tel.drain_spans()
-            _telemetry.push_records(client, self._ns,
-                                    self._worker_name, records)
+            # the monitor's zero-wire tap: our own drained batch is
+            # ingested directly (it still goes to the wire below for
+            # the cohort trace; poll skips fetching it back)
+            if self._monitor is not None and records:
+                self._monitor.ingest_local(records)
+            if final:
+                self._join_tel_push()
+                _telemetry.push_records(client, self._ns,
+                                        self._worker_name, records)
+                return
+            if not records:
+                return
+            if self._tel_pipe is None:
+                from autodist_tpu.runtime import coord_client as cc
+                coord_addr = getattr(self._coord, 'address', None)
+                self._tel_pipe = cc.TransferPool(
+                    [lambda: self._fenced_connect(coord_addr)])
+            self._join_tel_push()
+            ns, worker = self._ns, self._worker_name
+            self._tel_push_handle = self._tel_pipe.submit(
+                0, lambda c: _telemetry.push_records(c, ns, worker,
+                                                     records))
         except Exception as e:  # noqa: BLE001 - advisory plane
             logging.warning('telemetry batch push failed at step %d: '
                             '%s: %s', step, type(e).__name__, e)
@@ -1763,7 +1867,55 @@ class Session:
                 self._tel.record_span('step', t0, wall,
                                       step=self._step_count,
                                       worker=self._worker_name)
+            if self._monitor is not None:
+                self._monitor.observe_step(self._worker_name,
+                                           self._step_count, wall)
+                self._maybe_poll_monitor()
         return results
+
+    @property
+    def monitor(self):
+        """The chief's :class:`~autodist_tpu.telemetry.monitor.
+        CohortMonitor` (None off-chief, with telemetry disabled, or
+        under ``AUTODIST_STRAGGLER_POLICY=off``). Operators wire its
+        :meth:`metrics` into ``AutoscaleController(metrics_source=)``
+        so the built-in ``step_time_target_s`` policy runs on the
+        cohort's measured step time."""
+        return self._monitor
+
+    def _maybe_poll_monitor(self):
+        """Chief-side monitor cadence: poll the cohort's new span
+        batches every ``AUTODIST_TELEMETRY_PUSH_EVERY`` steps (the
+        batches only land on that cadence, so polling faster buys
+        nothing) and refit the cost model's link constants every
+        ``AUTODIST_RECALIBRATE_EVERY`` steps. Never fatal — the
+        sentry must not take down the training it observes."""
+        mon = self._monitor
+        if mon is None:
+            return
+        every = max(1, ENV.AUTODIST_TELEMETRY_PUSH_EVERY.val or 8)
+        if self._step_count % every:
+            return
+        try:
+            mon.poll()
+            if self._recalibrate_every and \
+                    self._step_count - self._last_recalibrate_step >= \
+                    self._recalibrate_every:
+                rs = getattr(self._cluster, '_resource_spec', None)
+                from autodist_tpu.simulator.cost_model import \
+                    CostModelParams
+                base = CostModelParams.from_topology(rs.topology) \
+                    if rs is not None else CostModelParams()
+                cross = rs.topology.multi_node if rs is not None \
+                    else False
+                if mon.recalibrate(base, num_replicas=max(2, self._world),
+                                   cross_node=cross,
+                                   step=self._step_count) is not None:
+                    self._last_recalibrate_step = self._step_count
+        except Exception as e:  # noqa: BLE001 - advisory plane
+            logging.warning('cohort monitor poll at step %d failed: '
+                            '%s: %s', self._step_count,
+                            type(e).__name__, e)
 
     def _run_fetches(self, fetches, feed_dict=None, options=None):
         if self._closed:
@@ -1936,9 +2088,15 @@ class Session:
         try:
             return job.result()
         finally:
+            blocked = _time.perf_counter() - t0
             with self._stats_lock:
-                self._ps_phase['exposed_wait_s'] += \
-                    _time.perf_counter() - t0
+                self._ps_phase['exposed_wait_s'] += blocked
+            # the 'pipeline' phase span: wire time the background
+            # pipeline FAILED to hide (the monitor's phase split and
+            # trace_view's per-phase columns both read it)
+            self._tel.record_span(
+                'pipeline_wait', t0, blocked,
+                step=self._step_count + 1, worker=self._worker_name)
 
     def _drain_pipeline(self, keep_prefetch=False):
         """Join any in-flight pipeline work: user-facing reads/writes
@@ -2663,12 +2821,33 @@ class Session:
                 try:
                     self._maybe_push_telemetry(
                         self._coord, self._step_count, final=True)
+                    if self._monitor is not None:
+                        # final verdict refresh over the last batches
+                        # so health_stats read after close() reflects
+                        # the whole run
+                        self._monitor.poll()
                     if self._is_chief:
                         self.export_chrome_trace()
                 except Exception as e:  # noqa: BLE001 - advisory
                     logging.warning('telemetry flush/export in close() '
                                     'failed: %s: %s',
                                     type(e).__name__, e)
+            if self._is_chief:
+                # the telemetry namespace must not outlive the run
+                # even when the purge quorum below is never reached (a
+                # peer that crashed, or a harness peer that never
+                # bumps 'closed'): a reused service would replay the
+                # stale batches — the per-worker batch counter hands
+                # the NEXT run's collector sequence numbers that
+                # decode to THIS run's spans. Collection and export
+                # happened above, so nothing is lost; batch keys AND
+                # the atomic counters live under <ns>/telemetry/ and
+                # go together.
+                try:
+                    self._coord.delete_namespace(
+                        self._key('telemetry/'))
+                except Exception:  # noqa: BLE001 - service may be gone
+                    pass
             self._flight.record('close', worker=self._worker_name,
                                 step=self._step_count,
                                 clean=drain_err is None)
@@ -2728,6 +2907,7 @@ class Session:
                 pass
         self._closed = True
         for pool in (getattr(self, '_pipe', None),
+                     getattr(self, '_tel_pipe', None),
                      getattr(self, '_pool', None)):
             if pool is not None:
                 pool.close()

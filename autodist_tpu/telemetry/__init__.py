@@ -13,9 +13,16 @@ Three layers (docs/design/observability.md):
 - :mod:`~autodist_tpu.telemetry.flight` — the always-on bounded ring
   of control-plane events, dumped on failure triggers and replayed
   through the protocol model by
-  :mod:`autodist_tpu.analysis.conformance`.
+  :mod:`autodist_tpu.analysis.conformance`;
+- :mod:`~autodist_tpu.telemetry.monitor` — the online performance
+  sentry: a chief-side streaming consumer of the span batches issuing
+  straggler verdicts with phase attribution, recording
+  ``slowdown``/``recovered`` flight events, feeding the autoscale
+  step-time signal and continuously recalibrating the cost model's
+  link constants.
 """
 from autodist_tpu.telemetry.aggregate import (chrome_trace,
+                                              collect_new_records,
                                               collect_records,
                                               decode_records,
                                               encode_records,
@@ -25,8 +32,14 @@ from autodist_tpu.telemetry.core import Telemetry, get, reset
 from autodist_tpu.telemetry.flight import (FlightRecorder, load_dump,
                                            recorder, telemetry_dir)
 from autodist_tpu.telemetry.flight import reset as reset_recorder
+from autodist_tpu.telemetry.monitor import (CohortMonitor,
+                                            format_snapshot,
+                                            phase_medians,
+                                            phase_splits)
 
 __all__ = ['Telemetry', 'get', 'reset', 'FlightRecorder', 'recorder',
            'reset_recorder', 'telemetry_dir', 'load_dump',
            'encode_records', 'decode_records', 'push_records',
-           'collect_records', 'chrome_trace', 'step_timeline']
+           'collect_records', 'collect_new_records', 'chrome_trace',
+           'step_timeline', 'CohortMonitor', 'phase_splits',
+           'phase_medians', 'format_snapshot']

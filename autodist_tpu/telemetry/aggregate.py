@@ -93,6 +93,51 @@ def collect_records(client, ns, workers):
     return out
 
 
+def collect_new_records(client, ns, workers, cursor):
+    """Incremental cohort collection for the online monitor: only the
+    batches pushed SINCE the previous call, judged per worker against
+    ``cursor`` (``{worker: last consumed batch seq}``, updated in
+    place) — the chief polls every few steps, so re-reading the whole
+    batch history each time would grow the poll cost linearly with run
+    length. Never fatal; and unlike :func:`collect_records` (which
+    re-reads the full range every call) a batch missing from the
+    middle of the range is NOT skipped: ``push_records`` bumps the
+    atomic counter BEFORE the tensor write lands, so a poll racing an
+    in-flight push sees the seq but not yet the bytes — the cursor
+    only advances past batches that actually decoded, and the
+    consumed prefix stops at the first gap so the in-flight batch is
+    retried next poll instead of dropped forever."""
+    out = []
+    for worker in workers:
+        try:
+            n = client.incr('%s/telemetry/%s/batches' % (ns, worker), 0)
+            last = int(cursor.get(worker, 0))
+            if n <= last:
+                continue
+            specs = [('%s/telemetry/%s/b%d' % (ns, worker, i), None)
+                     for i in range(last + 1, n + 1)]
+            consumed = last
+            for seq, arr in zip(range(last + 1, n + 1),
+                                client.vmget(specs, wire='f32')):
+                if arr is None:
+                    # counter-bumped but not yet written: stop the
+                    # consumed prefix here; this and any later batch
+                    # re-fetch next poll (ingestion is step-keyed, so
+                    # nothing downstream double-counts either way)
+                    break
+                for rec in decode_records(arr):
+                    rec.setdefault('worker', worker)
+                    out.append(rec)
+                consumed = seq
+            cursor[worker] = consumed
+        except Exception as e:  # noqa: BLE001 - best-effort stream
+            logging.warning(
+                'incremental telemetry collection for %s/%s failed: '
+                '%s: %s', ns, worker, type(e).__name__, e)
+    out.sort(key=lambda r: r.get('t0', 0.0))
+    return out
+
+
 def _worker_ordinal(worker):
     try:
         return int(str(worker).lstrip('p'))

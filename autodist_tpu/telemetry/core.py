@@ -20,8 +20,12 @@ Cost contract (the tentpole's overhead budget):
   allocation, no clock read) and every other recording call returns
   after a single attribute check;
 - **enabled**: one ``perf_counter`` pair + one bounded-deque append
-  per span; ≤ 2% step time on the CPU smoke, measured by
-  ``bench.bench_telemetry``'s on-vs-off A/B.
+  per span (~3 us measured); batch pushes ride the session's
+  dedicated background lane, never the step's critical path. ≤ 2%
+  step time on the CPU smoke, measured by ``bench.bench_telemetry``'s
+  per-record decomposition (records/step x measured record cost +
+  the on-path drain share of a push — the raw on-vs-off wall delta
+  is recorded as context but is scheduler noise at ms-scale steps).
 
 Buffers are bounded (``AUTODIST_TELEMETRY_MAX_SPANS``): telemetry must
 never grow without bound on a long run — old spans fall off the front

@@ -362,6 +362,12 @@ def health_report(health_stats, faultline=None, autoscale=None):
             'failed': sum(1 for d in decisions
                           if d.get('action') == 'failed'),
         },
+        # online performance sentry (telemetry/monitor.py): rolling
+        # cohort stats, active straggler verdicts with phase
+        # attribution (exclude candidates under policy=advise), the
+        # slowdown/recovered transition audit and the recalibration
+        # trajectory. {} when the chief ran no monitor.
+        'perf': dict(hs.get('perf') or {}),
         'auto_checkpoints': hs.get('auto_checkpoints', 0),
         'connect_retries': RETRY_STATS['connect_retries'],
         'injected_faults': [
@@ -423,6 +429,29 @@ def format_health(report):
         lines.append('  autoscale: %d taken / %d skipped / %d failed'
                      % (auto.get('taken', 0), auto.get('skipped', 0),
                         auto.get('failed', 0)))
+    perf = report.get('perf') or {}
+    if perf.get('workers'):
+        lines.append(
+            '  perf: cohort step %.1fms over %d workers  (%d slowdown '
+            '/ %d recovered, %d recalibration(s), policy=%s)'
+            % (1e3 * perf.get('step_time_s', 0.0),
+               len(perf['workers']), perf.get('slowdowns', 0),
+               perf.get('recoveries', 0),
+               len(perf.get('recalibrations', ())),
+               perf.get('policy', '?')))
+        for v in perf.get('verdicts', ()):
+            lines.append(
+                '    straggler %s: %s %.1fms vs %.1fms — %d%% of '
+                'excess in %s ⇒ %s%s'
+                % (v.get('worker'), v.get('statistic', '?'),
+                   1e3 * v.get('stat_s', 0.0),
+                   1e3 * v.get('baseline_s', 0.0),
+                   int(100 * (v.get('phase_shares') or {}).get(
+                       v.get('attributed_phase'), 0.0)),
+                   v.get('attributed_phase'),
+                   v.get('classification'),
+                   ' [exclude candidate]'
+                   if v.get('exclude_candidate') else ''))
     for ex in report['exclusions']:
         lines.append('  excluded %s at epoch %d'
                      % (ex.get('worker'), ex.get('epoch', -1)))

@@ -1,0 +1,149 @@
+"""tools/bench_compare.py: BENCH-record diffing per stable key —
+regression exit codes, cross-platform refusal, missing-key tolerance,
+and the subprocess smoke (the satellite's tier-1 hook)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.bench_compare import compare, load_record  # noqa: E402
+
+
+def _record(platform='cpu', sync_s=0.001, value=100.0,
+            overhead=0.005, detection=2, wrapped=True):
+    rec = {
+        'metric': 'tiny_lm_cpu_smoke_tokens_per_sec_per_chip',
+        'value': value, 'unit': 'tokens/s/chip', 'vs_baseline': 0.0,
+        'extra': {
+            'platform': platform,
+            'grad_sync': {'per_step_sync_time_s': sync_s,
+                          'sync_wire_bytes': 1000},
+            'quantized': {'grad_sync': {'bytes_reduction': 3.9},
+                          'ps_push': {'push_bytes_reduction': 3.9}},
+            'hierarchical': {'dcn_bytes_reduction': 7.0},
+            'elastic': {'admit_wall_s': 0.004, 'steps_blocked': 0},
+            'ps_pipeline': {'depth2': {'overlap_frac': 0.8},
+                            'depth2_speedup': 1.1},
+            'telemetry': {'overhead_frac': overhead},
+            'monitor': {'detection_steps': detection,
+                        'overhead_frac': 0.01,
+                        'clean': {'false_positive_verdicts': 0}},
+        },
+    }
+    if wrapped:
+        return {'n': 1, 'cmd': 'bench.py', 'rc': 0, 'tail': '',
+                'parsed': rec}
+    return rec
+
+
+def _write(tmp_path, name, rec):
+    p = tmp_path / name
+    p.write_text(json.dumps(rec))
+    return str(p)
+
+
+def test_load_record_unwraps_and_rejects(tmp_path):
+    wrapped = _write(tmp_path, 'w.json', _record())
+    raw = _write(tmp_path, 'r.json', _record(wrapped=False))
+    assert load_record(wrapped)['metric'] == \
+        load_record(raw)['metric']
+    bad = _write(tmp_path, 'bad.json',
+                 {'n': 1, 'rc': 1, 'parsed': None})
+    with pytest.raises(ValueError, match='not a BENCH record'):
+        load_record(bad)
+
+
+def test_compare_clean_and_regression_directions():
+    old = _record(wrapped=False)
+    # better on every axis: no regression
+    better = _record(wrapped=False, sync_s=0.0009, value=120.0,
+                     overhead=0.004, detection=1)
+    rep = compare(old, better)
+    assert rep['clean'] and rep['regressions'] == 0
+    # a lower-is-better metric getting worse past the threshold
+    worse = _record(wrapped=False, sync_s=0.002)
+    rep = compare(old, worse, threshold=0.10)
+    assert not rep['clean']
+    rows = {r['metric']: r for r in rep['rows']}
+    assert rows['extra.grad_sync.per_step_sync_time_s']['status'] == \
+        'regression'
+    # a higher-is-better metric (throughput) dropping
+    slower = _record(wrapped=False, value=50.0)
+    rep = compare(old, slower)
+    assert {r['metric']: r for r in rep['rows']}['value']['status'] \
+        == 'regression'
+    # inside the threshold: ok
+    rep = compare(old, _record(wrapped=False, sync_s=0.00105))
+    assert rep['clean']
+
+
+def test_failure_sentinel_is_a_regression_not_an_improvement():
+    """detection_steps=-1 means the straggler was NEVER detected: the
+    sentinel is numerically 'best' under lower-is-better and must not
+    wave the worst possible monitor regression through the gate."""
+    old = _record(wrapped=False, detection=3)
+    broken = _record(wrapped=False, detection=-1)
+    rep = compare(old, broken)
+    row = {r['metric']: r for r in rep['rows']}[
+        'extra.monitor.detection_steps']
+    assert row['status'] == 'regression' and 'sentinel' in row['note']
+    assert not rep['clean']
+    # the other direction: a run that could not detect before now can
+    rep = compare(broken, old)
+    row = {r['metric']: r for r in rep['rows']}[
+        'extra.monitor.detection_steps']
+    assert row['status'] == 'ok' and 'sentinel' in row['note']
+
+
+def test_compare_tolerates_missing_keys():
+    old = _record(wrapped=False)
+    del old['extra']['monitor']          # an older record
+    rep = compare(old, _record(wrapped=False))
+    skipped = [r for r in rep['rows'] if r['status'] == 'skipped']
+    assert any(r['key'] == 'monitor' for r in skipped)
+    assert rep['clean']                  # missing is never a failure
+
+
+def test_cli_exit_codes_and_platform_refusal(tmp_path):
+    cli = os.path.join(REPO, 'tools', 'bench_compare.py')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    old = _write(tmp_path, 'old.json', _record())
+    same = _write(tmp_path, 'same.json', _record())
+    worse = _write(tmp_path, 'worse.json', _record(sync_s=0.01))
+    tpu = _write(tmp_path, 'tpu.json', _record(platform='tpu'))
+
+    out = subprocess.run([sys.executable, cli, old, same],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'CLEAN' in out.stdout
+
+    out = subprocess.run([sys.executable, cli, old, worse, '--json'],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert out.returncode == 1
+    rep = json.loads(out.stdout)
+    assert rep['regressions'] >= 1
+
+    # cross-platform: refused with exit 2, overridable
+    out = subprocess.run([sys.executable, cli, old, tpu],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert out.returncode == 2
+    assert 'REFUSED' in out.stderr
+    out = subprocess.run(
+        [sys.executable, cli, old, tpu, '--allow-cross-platform'],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert out.returncode in (0, 1)      # compared, not refused
+
+    # unusable input
+    bad = _write(tmp_path, 'b.json', {'rc': 1, 'parsed': None})
+    out = subprocess.run([sys.executable, cli, old, bad],
+                         capture_output=True, text=True, timeout=60,
+                         env=env, cwd=REPO)
+    assert out.returncode == 2

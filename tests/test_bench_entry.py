@@ -98,6 +98,25 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert tr['steps_aligned'], tr
         assert tl['conformance']['clean'], tl['conformance']
         assert tl['sim_drift'].get('candidates'), tl['sim_drift']
+    # ISSUE 12: every record carries the monitor block under its
+    # stable key — the injected delay_conn straggler detected with
+    # push attribution within the step budget, ZERO false positives
+    # on the clean leg, poll overhead inside the telemetry budget,
+    # and a mid-slowdown flight dump that replays conformant
+    mo = extra['monitor']
+    if shutil.which('g++'):
+        assert 'error' not in mo, mo
+        assert mo['clean']['false_positive_verdicts'] == 0, mo
+        st = mo['straggler']
+        assert st['detected'] and st['verdict_worker'] == 'p1', st
+        assert st['attributed_phase'] == 'push', st
+        assert st['classification'] == 'link_or_host', st
+        assert st['exclude_candidate'] is True, st
+        assert 0 <= mo['detection_steps'] <= \
+            mo['detection_budget_steps'], mo
+        assert mo['overhead_frac'] <= mo['overhead_budget_frac'], mo
+        assert mo['dump']['slowdown_events'] >= 1, mo['dump']
+        assert mo['dump']['conformance_clean'], mo['dump']
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

@@ -1,0 +1,192 @@
+"""Diff two BENCH records per stable key — the machine-readable half
+of the bench trajectory.
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_compare.py OLD.json NEW.json --threshold 0.15
+    python tools/bench_compare.py OLD.json NEW.json --json
+
+Each BENCH_r*.json is either the driver wrapper (``{'parsed': {...}}``)
+or bench.py's raw output line. The comparison walks a curated metric
+table grouped by the stable record keys (grad_sync, quantized,
+hierarchical, elastic, ps_pipeline, telemetry, monitor, top-level
+throughput) with a per-metric direction; a NEW value worse than OLD by
+more than ``--threshold`` (fractional, default 0.10) is a REGRESSION.
+Metrics missing from either record are reported as skipped, never
+fatal — older records predate newer keys.
+
+Cross-platform comparisons are REFUSED (exit 2): records carry
+``extra.platform``, and a CPU-smoke number regressing against a TPU
+number is noise wearing a trend costume. ``--allow-cross-platform``
+overrides for exploratory use.
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = unusable input /
+platform refusal.
+"""
+import argparse
+import json
+import sys
+
+#: (stable key, dotted path, direction, label). Direction 'lower' =
+#: smaller is better (times, overhead), 'higher' = bigger is better
+#: (throughput, reduction ratios, overlap).
+METRICS = (
+    ('top', 'value', 'higher', 'headline throughput'),
+    ('grad_sync', 'extra.grad_sync.per_step_sync_time_s', 'lower',
+     'per-step grad sync time'),
+    ('grad_sync', 'extra.grad_sync.sync_wire_bytes', 'lower',
+     'grad sync wire bytes'),
+    ('quantized', 'extra.quantized.grad_sync.bytes_reduction', 'higher',
+     'int8 grad-sync wire reduction'),
+    ('quantized', 'extra.quantized.ps_push.push_bytes_reduction',
+     'higher', 'int8 PS push-byte reduction'),
+    ('hierarchical', 'extra.hierarchical.dcn_bytes_reduction', 'higher',
+     'two-level DCN byte reduction'),
+    ('elastic', 'extra.elastic.admit_wall_s', 'lower',
+     'elastic admit wall time'),
+    ('elastic', 'extra.elastic.steps_blocked', 'lower',
+     'steps blocked by the join'),
+    ('ps_pipeline', 'extra.ps_pipeline.depth2.overlap_frac', 'higher',
+     'PS pipeline depth-2 overlap fraction'),
+    ('ps_pipeline', 'extra.ps_pipeline.depth2_speedup', 'higher',
+     'PS pipeline depth-2 speedup'),
+    ('telemetry', 'extra.telemetry.overhead_frac', 'lower',
+     'telemetry overhead fraction'),
+    ('monitor', 'extra.monitor.detection_steps', 'lower',
+     'straggler detection latency (steps)'),
+    ('monitor', 'extra.monitor.clean.false_positive_verdicts', 'lower',
+     'clean-leg false positives'),
+    ('monitor', 'extra.monitor.overhead_frac', 'lower',
+     'monitor poll overhead fraction'),
+)
+
+
+def load_record(path):
+    """A BENCH file -> the bench.py result dict (unwrapping the
+    driver's ``{'parsed': ...}`` envelope). Raises ValueError when
+    neither shape fits."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict) and isinstance(
+            payload.get('parsed'), dict):
+        payload = payload['parsed']
+    if not isinstance(payload, dict) or 'metric' not in payload:
+        raise ValueError(
+            '%s: not a BENCH record (no parsed bench result with a '
+            "'metric' field — rc!=0 runs carry parsed=null)" % path)
+    return payload
+
+
+def _lookup(record, path):
+    cur = record
+    for part in path.split('.'):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) and not isinstance(
+        cur, bool) else None
+
+
+def compare(old, new, threshold=0.10):
+    """Walk the metric table; returns the report dict."""
+    rows = []
+    regressions = 0
+    for key, path, direction, label in METRICS:
+        a, b = _lookup(old, path), _lookup(new, path)
+        row = {'key': key, 'metric': path, 'label': label,
+               'direction': direction, 'old': a, 'new': b}
+        if a is None or b is None:
+            row['status'] = 'skipped'
+            row['note'] = 'missing in %s record' % (
+                'both' if a is None and b is None
+                else ('old' if a is None else 'new'))
+        elif direction == 'lower' and (a < 0 or b < 0):
+            # a negative lower-is-better value is a FAILURE SENTINEL
+            # (e.g. detection_steps=-1 = the straggler was never
+            # detected) — numerically it would read as the best
+            # possible value and wave the worst possible regression
+            # through the gate
+            if b < 0:
+                row['status'] = 'regression'
+                row['note'] = ('failure sentinel in new record '
+                               '(%g): the measurement itself failed'
+                               % b)
+                regressions += 1
+            else:
+                row['status'] = 'ok'
+                row['note'] = ('old record carries a failure '
+                               'sentinel (%g); any measured new '
+                               'value is an improvement' % a)
+        else:
+            if direction == 'lower':
+                # worse = bigger; ratio vs the old value, with an
+                # absolute epsilon so 0 -> 0.0001 (a count appearing)
+                # still registers against a zero baseline
+                worse = (b - a) / a if a else (1.0 if b > 1e-12 else 0.0)
+            else:
+                worse = (a - b) / a if a else 0.0
+            row['delta_frac'] = round(worse, 4)
+            row['status'] = 'regression' if worse > threshold else 'ok'
+            if row['status'] == 'regression':
+                regressions += 1
+        rows.append(row)
+    return {'threshold': threshold, 'rows': rows,
+            'regressions': regressions, 'clean': regressions == 0}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='diff two BENCH records per stable key; nonzero '
+                    'exit on regression')
+    ap.add_argument('old')
+    ap.add_argument('new')
+    ap.add_argument('--threshold', type=float, default=0.10,
+                    help='fractional regression threshold (default '
+                         '0.10 = 10%%)')
+    ap.add_argument('--allow-cross-platform', action='store_true',
+                    help='compare records from different platforms '
+                         'anyway (normally refused)')
+    ap.add_argument('--json', action='store_true',
+                    help='print the machine-readable report')
+    args = ap.parse_args(argv)
+    try:
+        old = load_record(args.old)
+        new = load_record(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print('bench_compare: %s' % e, file=sys.stderr)
+        return 2
+    p_old = (old.get('extra') or {}).get('platform')
+    p_new = (new.get('extra') or {}).get('platform')
+    if p_old and p_new and p_old != p_new and \
+            not args.allow_cross_platform:
+        print('bench_compare: REFUSED — %s is a %r record, %s is %r; '
+              'cross-platform deltas are noise, not a trend '
+              '(--allow-cross-platform to override)'
+              % (args.old, p_old, args.new, p_new), file=sys.stderr)
+        return 2
+    report = compare(old, new, threshold=args.threshold)
+    report['platform'] = p_new or p_old
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for row in report['rows']:
+            if row['status'] == 'skipped':
+                print('  skip  %-38s (%s)' % (row['label'], row['note']))
+                continue
+            mark = {'ok': '  ok  ', 'regression': 'REGR  '}[row['status']]
+            if 'delta_frac' not in row:   # failure-sentinel rows
+                print('%s%-38s %12.6g -> %-12.6g (%s)'
+                      % (mark, row['label'], row['old'], row['new'],
+                         row['note']))
+                continue
+            print('%s%-38s %12.6g -> %-12.6g (%+.1f%% worse, %s '
+                  'better)' % (mark, row['label'], row['old'],
+                               row['new'], 100 * row['delta_frac'],
+                               row['direction']))
+        print('bench_compare %s: %d regression(s) at threshold %.0f%%'
+              % ('CLEAN' if report['clean'] else 'FAILED',
+                 report['regressions'], 100 * args.threshold))
+    return 0 if report['clean'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

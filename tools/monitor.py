@@ -1,0 +1,116 @@
+"""Online performance sentry CLI: cohort table + straggler verdicts.
+
+    # live: poll a running cohort's telemetry namespace off the coord
+    # service (the chief's in-process CohortMonitor is the twin)
+    python tools/monitor.py --addr 127.0.0.1:14998 --ns <strategy id> \\
+        --workers 4 [--poll 5 --interval 2.0]
+
+    # offline: span-record batch files (the telemetry.aggregate
+    # schema — what trace_view also reads)
+    python tools/monitor.py records.json --json
+
+Renders the per-worker rolling statistics (median step wall, work
+time, per-phase medians — gate / pull / push / pipeline / compute) and
+every active straggler verdict with its phase attribution ("86% of the
+excess is gate-wait ⇒ upstream victim, not culprit"). ``--json``
+prints the machine-readable monitor snapshot (the same dict
+``health_report``'s perf section carries). Exit 0 always (including
+when verdicts are active — the sentry observes, scripts decide);
+nonzero only on unusable input.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_records(path):
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, list):
+        raise ValueError(
+            '%s: not a span-record batch list (flight dumps and Chrome '
+            'traces belong to tools/trace_view.py)' % path)
+    return payload
+
+
+def main(argv=None):
+    from autodist_tpu.telemetry.monitor import (CohortMonitor,
+                                                format_snapshot)
+    ap = argparse.ArgumentParser(
+        description='cohort performance table + straggler verdicts '
+                    'from the telemetry plane')
+    ap.add_argument('paths', nargs='*',
+                    help='span-record batch files (offline mode)')
+    ap.add_argument('--addr', help='coord service host:port for live '
+                                   'polling')
+    ap.add_argument('--ns', help='run namespace (strategy id) for '
+                                 'live polling')
+    ap.add_argument('--workers', type=int, default=2,
+                    help='worker count for live polling')
+    ap.add_argument('--poll', type=int, default=1,
+                    help='live mode: how many poll rounds')
+    ap.add_argument('--interval', type=float, default=2.0,
+                    help='live mode: seconds between poll rounds')
+    ap.add_argument('--window', type=int, default=None,
+                    help='rolling-stat window override '
+                         '(AUTODIST_MONITOR_WINDOW)')
+    ap.add_argument('--warmup', type=int, default=2,
+                    help='steps excluded from baselines as '
+                         'compile/warm-up')
+    ap.add_argument('--policy', default=None,
+                    choices=('off', 'warn', 'advise'),
+                    help='verdict policy override '
+                         '(AUTODIST_STRAGGLER_POLICY)')
+    ap.add_argument('--json', action='store_true',
+                    help='print the machine-readable snapshot')
+    args = ap.parse_args(argv)
+
+    live = bool(args.addr and args.ns)
+    if not live and not args.paths:
+        print('monitor: need record files or --addr/--ns',
+              file=sys.stderr)
+        return 1
+    client = None
+    if live:
+        from autodist_tpu.runtime.coord_client import CoordClient
+        host, port = args.addr.rsplit(':', 1)
+        client = CoordClient((host, int(port)))
+    try:
+        # confirmations=1: the chief's in-process monitor uses
+        # hysteresis against flapping, but a single-shot CLI
+        # inspection has exactly one round — it must not be eaten
+        mon = CohortMonitor(
+            client=client, ns=args.ns,
+            workers=['p%d' % i for i in range(args.workers)],
+            window=args.window, warmup_steps=args.warmup,
+            confirmations=1, policy=args.policy)
+        for path in args.paths:
+            mon.ingest(_load_records(path))
+        if args.paths:
+            mon.update_verdicts()
+        if live:
+            import time
+            for i in range(max(1, args.poll)):
+                n = mon.poll()
+                if not args.json and args.poll > 1:
+                    print('poll %d/%d: %d new record(s)'
+                          % (i + 1, args.poll, n))
+                if i + 1 < args.poll:
+                    time.sleep(args.interval)
+        snap = mon.snapshot()
+        if args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+        else:
+            print(format_snapshot(snap))
+        return 0
+    finally:
+        if client is not None:
+            client.close()
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

@@ -94,12 +94,19 @@ def main(argv=None):
             json.dump(trace, f)
     timeline = step_timeline(records)
     workers = sorted({r.get('worker', 'p0') for r in records})
+    # per-phase aggregate columns (gate/pull/push/pipeline/compute
+    # medians per worker) through the SAME phase-split helper the
+    # monitor's verdicts use — one implementation, pinned by a shared
+    # test, so the CLI and the verdicts cannot drift
+    from autodist_tpu.telemetry.monitor import phase_medians
+    phases = phase_medians(records)
     summary = {
         'workers': workers,
         'span_records': len(records),
         'flight_events': len(flight_events),
         'trace_events': len(trace['traceEvents']),
         'steps': {str(s): timeline[s] for s in sorted(timeline)},
+        'phases': phases,
         'out': args.out or None,
     }
     if args.json:
@@ -114,6 +121,13 @@ def main(argv=None):
             row = '  step %-4d ' % s + '  '.join(
                 '%s %.1fms' % (w, dt * 1e3)
                 for w, dt in sorted(timeline[s].items()))
+            print(row)
+        for w in sorted(phases):
+            agg = phases[w]
+            row = '  %s medians:' % w + ''.join(
+                '  %s %.1fms' % (p, 1e3 * agg[p])
+                for p in ('step', 'gate', 'pull', 'push', 'pipeline',
+                          'compute') if p in agg)
             print(row)
     return 0
 
