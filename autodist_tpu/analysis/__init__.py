@@ -1,7 +1,7 @@
 """Static analysis over the distributed runtime — tier-1 correctness
 backstops that run with no devices and no processes.
 
-Four analyzers, one CLI (``tools/analyze.py``):
+Six analyzers, one CLI (``tools/analyze.py``):
 
 - :mod:`~autodist_tpu.analysis.protocol_model` +
   :mod:`~autodist_tpu.analysis.explore` — an executable small-scope
@@ -12,14 +12,32 @@ Four analyzers, one CLI (``tools/analyze.py``):
   PR 6's admit-ordering inversion) re-derive as counterexample traces
   when the model is flipped to the pre-fix orderings; HEAD's orderings
   explore clean.
+- :mod:`~autodist_tpu.analysis.data_plane_model` — the same treatment
+  for the PS **data plane**: chunked write sequences + torn-read
+  version parity, the disconnect-time sequence abort, the
+  under-tensor-lock fence re-check, the depth-2 pipeline's prefetch
+  peer-floor guard, and the telemetry batch-counter/cursor protocol.
+  Three more historical bugs (PR 1's offset-0 abort, PR 5's
+  disconnect wedge, PR 11's cursor race) re-derive as counterexample
+  traces.
+- :mod:`~autodist_tpu.analysis.epoch_swap_model` — the PROSPECTIVE
+  strategy-distribution-epoch handshake (ROADMAP 2), verified before
+  it ships: the stage → ack-quorum → boundary-arm → swap-at-boundary
+  ordering explores clean, and the tempting-but-wrong orderings
+  (swap-before-ack-quorum, naive chief-step boundary)
+  counterexample. The clean ordering is the implementation contract
+  in ``docs/design/static-analysis.md``.
 - :mod:`~autodist_tpu.analysis.fence_lint` — parses the native
   ``coord_service.cc`` dispatcher and proves every mutating command is
   fence-checked (with the under-tensor-lock re-check for ``B*``
-  commands) and documented; absorbs ``tools/check_protocol.py``.
+  commands), every size-declaring command bounds its declared
+  allocation against ``kMaxPayload`` before allocating, and the
+  header stays in sync; absorbs ``tools/check_protocol.py``.
 - :mod:`~autodist_tpu.analysis.env_lint` — every ``AUTODIST_*`` env
   read in the tree must be declared in ``const.py``'s ENV registry,
-  and every worker-affecting knob must ride the coordinator's
-  forwarding set (or carry an explicit exemption reason).
+  every worker-affecting knob must ride the coordinator's forwarding
+  set (or carry an explicit exemption reason), and every knob must be
+  documented under ``docs/`` with choice sets in sync.
 - :mod:`~autodist_tpu.analysis.schedule_lint` — cross-checks
   ``plan.sync_gradients``'s emission predicates against
   ``static_collective_schedule`` at the AST level, verifies

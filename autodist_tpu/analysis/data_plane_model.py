@@ -1,0 +1,725 @@
+"""Executable small-scope model of the PS **data plane**.
+
+The control-plane checker (:mod:`~autodist_tpu.analysis.
+protocol_model`) covers membership, fencing and gate orderings — but
+three of the four review passes' worth of real concurrency bugs lived
+one layer down, in the tensor data plane, guarded until now only by
+hand reasoning:
+
+- **PR 1's offset-0 abort**: ``abort_open_seq`` decremented
+  ``open_writes`` for ANY rejected frame, so one malformed offset-0
+  frame (which never opened a sequence — ``SeqFrame`` is constructed
+  after the checks) closed ANOTHER writer's in-flight chunked
+  sequence and cleared the torn-read parity bit under its feet — a
+  reader then accepted half-written data as clean.
+- **PR 5's disconnect wedge**: a writer killed between chunks (the
+  exclude/restart policies' core died-mid-push case) sent no further
+  frames; without the disconnect-time ``SeqAborter`` its sequence
+  held ``open_writes`` forever and every reader retried odd parity
+  until a ``DELNS``.
+- **PR 11's telemetry-cursor race**: ``push_records`` bumps the
+  atomic batch counter BEFORE the tensor write lands, so a monitor
+  poll racing an in-flight push saw the seq but not the bytes — a
+  cursor that advanced to the counter dropped that batch forever.
+
+This module models exactly the cross-process data-plane state those
+bugs live in, reusing the explorer unchanged via the
+:class:`~autodist_tpu.analysis.protocol_model.Scenario` hooks:
+
+- the **tensor store**: per-key ``version``/``open_writes`` torn-read
+  bookkeeping, ``SeqFrame`` chunked-sequence semantics (offset-0
+  opens, final chunk closes, every rejection aborts), the offset-0
+  abort rule, the disconnect-time ``SeqAborter``, and the B*
+  fence-recheck-under-tensor-lock window (the wire-entry check and
+  the commit are separate transitions, so a fence bump can land
+  between them) — shared by the dense (BSET/BADD) and row-sparse
+  (BSADD, ranges counting ROWS) writers, which differ only in what a
+  "chunk" is;
+- the **versioned reader** (BGET/BGETROWS ``v`` contract): a
+  multi-chunk read accepts only when both version snapshots are even
+  and equal, else retries — exactly ``coord_client``'s torn-read
+  loop;
+- the **session pipeline at depth 2**: join → gate → serve-prefetch →
+  push → publish → peer-floor scan → pull-ahead, every RPC its own
+  transition, with the peer-floor staleness guard (``run()`` discards
+  a prefetch whose recorded floor is below the next step's staleness
+  bound) and its ordering (floor read after publish, before the
+  pull-ahead) as configuration;
+- the **telemetry batch-counter/cursor protocol**: counter bump and
+  batch write as separate transitions (the real race window), the
+  monitor's incremental cursor with its advance rule as
+  configuration, and a close-time final sweep.
+
+Invariants:
+
+- **no torn read surfaces as clean** — an accepted (even, equal
+  version) read must never observe chunks of a still-open write
+  sequence (a sequence *aborted* by disconnect or rejection is
+  legitimate partial data the staleness model absorbs — that is the
+  service's documented contract, and the model encodes it);
+- **no fenced zombie frame commits** after its fence bump (the
+  under-tensor-lock re-check);
+- **no reader wedges on odd parity after ANY writer death**
+  (liveness: the stuck diagnosis names the wedged reader and the key
+  whose parity is stuck odd);
+- **prefetches never violate the serial staleness bound** — a served
+  prefetch must contain every peer push the gate just guaranteed;
+- **the cursor never permanently skips a decodable batch** (terminal
+  invariant: after the final sweep, every batch whose bytes landed
+  was consumed).
+
+What it deliberately does NOT model: payload values and shapes (the
+chunk stamps track write identity, not bytes — BSADD's index/shape
+validation is the fence lint's and the real service tests' job),
+multi-key stores (one tensor key per scenario; per-key locks don't
+interact), the stall-window timeout of the reader's retry loop
+(unbounded retry + liveness detection is strictly stronger), wire
+dtypes, and the control-plane orderings already covered by
+``protocol_model``. See ``docs/design/static-analysis.md``.
+"""
+from dataclasses import dataclass, replace
+
+from autodist_tpu.analysis.protocol_model import Scenario, _set_violation
+
+
+@dataclass(frozen=True)
+class DataPlaneConfig:
+    """Orderings under test. Defaults are HEAD's (must explore clean);
+    each historical bug is one field flipped back."""
+
+    #: which rejected frames abort an open sequence: 'continuation_only'
+    #: (HEAD — only a declared offset > 0 frame can have opened one)
+    #: vs 'any_frame' (the pre-PR 1 rule: a malformed offset-0 frame
+    #: closes ANOTHER writer's sequence).
+    abort_offset0: str = 'continuation_only'
+    #: whether a dead connection's open chunk sequences are aborted at
+    #: disconnect (HEAD's SeqAborter) — False is the pre-PR 5 service.
+    disconnect_abort: bool = True
+    #: where B* handlers check the fence: 'under_lock' (HEAD — the
+    #: commit re-checks under the tensor lock) vs 'entry_only' (the
+    #: wire-entry check alone; one in-flight zombie frame can commit
+    #: after its fence bump).
+    fence_recheck: str = 'under_lock'
+    #: run()'s prefetch guard: 'floor_discard' (HEAD — a prefetch whose
+    #: recorded peer floor is below the next step's staleness bound is
+    #: discarded) vs 'serve_always' (the pre-review PR 3 pipeline).
+    prefetch_guard: str = 'floor_discard'
+    #: when the pipeline job reads the peer floor: 'after_publish'
+    #: (HEAD — push -> publish -> floor -> pull-ahead, so the floor
+    #: lower-bounds what the pull observed) vs 'after_pull' (floor
+    #: read last, overstating what the prefetch contains).
+    floor_scan: str = 'after_publish'
+    #: the monitor cursor's advance rule: 'decoded_prefix' (HEAD — the
+    #: consumed prefix stops at the first not-yet-landed batch) vs
+    #: 'counter' (pre-PR 11: advance to the counter, dropping the
+    #: in-flight batch forever).
+    cursor_advance: str = 'decoded_prefix'
+    #: training steps per worker in the pipeline scenario.
+    steps: int = 2
+    #: staleness window of the pipeline scenario's gate.
+    staleness: int = 1
+    #: mid-run monitor polls in the telemetry scenario (the close-time
+    #: final sweep is extra).
+    polls: int = 2
+
+
+HEAD = DataPlaneConfig()
+#: PR 1's historical bug: any rejected frame decremented open_writes.
+PR1_OFFSET0_ABORT = replace(HEAD, abort_offset0='any_frame')
+#: PR 5's historical bug: no disconnect-time sequence abort.
+PR5_DISCONNECT_WEDGE = replace(HEAD, disconnect_abort=False)
+#: PR 11's historical bug: the cursor advanced to the batch counter.
+PR11_CURSOR_RACE = replace(HEAD, cursor_advance='counter')
+#: Same class, not historical: fence checked at wire entry only.
+UNLOCKED_FENCE_RECHECK = replace(HEAD, fence_recheck='entry_only')
+#: ...the pipeline serving a too-early prefetch unguarded...
+NO_FLOOR_DISCARD = replace(HEAD, prefetch_guard='serve_always')
+#: ...and the floor read AFTER the pull-ahead it must lower-bound.
+FLOOR_AFTER_PULL = replace(HEAD, floor_scan='after_pull')
+
+
+# -- tensor-store semantics ----------------------------------------------
+
+def _t_open(m, key):
+    return m['counters'].get('t/%s/open' % key, 0)
+
+
+def _t_ver(m, key):
+    return m['counters'].get('t/%s/ver' % key, 0)
+
+
+def _t_parity(m, key):
+    """The BGET/BGETROWS 'v' reply: version*2 + (open_writes>0)."""
+    return _t_ver(m, key) * 2 + (1 if _t_open(m, key) > 0 else 0)
+
+
+def _seq_open(m, key, proc):
+    """The write-id ``proc``'s connection holds open on ``key``
+    (conn->open_seqs), or ''."""
+    return m['kv'].get('seq/%s/%s' % (key, proc), '')
+
+
+def seq_open_frame(m, key, proc, wid):
+    """Offset-0 frame of a chunked write: opens the sequence
+    (``++open_writes``, conn->open_seqs.insert)."""
+    m['counters']['t/%s/open' % key] = _t_open(m, key) + 1
+    m['kv']['seq/%s/%s' % (key, proc)] = wid
+
+
+def seq_close(m, key, proc):
+    """Release one open_writes slot + the connection's open-seq entry
+    — the shared tail of finish(final), fail() and the aborts."""
+    if _t_open(m, key) > 0:
+        m['counters']['t/%s/open' % key] = _t_open(m, key) - 1
+    m['kv'].pop('seq/%s/%s' % (key, proc), None)
+
+
+def seq_abort_rejected(m, cfg, key, proc, off_declared):
+    """abort_open_seq: a rejected frame's cleanup. HEAD only aborts
+    when the frame DECLARED a continuation offset (off > 0) — an
+    offset-0 frame never opened a sequence, so decrementing for it
+    closes another writer's. The pre-PR 1 rule decrements for any
+    rejected frame."""
+    if cfg.abort_offset0 == 'continuation_only' and off_declared <= 0:
+        return
+    # the pre-fix decrement hits the TENSOR counter even though this
+    # connection opened nothing — exactly the bug
+    if _t_open(m, key) > 0:
+        m['counters']['t/%s/open' % key] = _t_open(m, key) - 1
+    m['kv'].pop('seq/%s/%s' % (key, proc), None)
+
+
+def disconnect_abort(m, cfg, proc):
+    """serve_conn's SeqAborter: abort every sequence the dead
+    connection still holds open (HEAD); the pre-PR 5 service leaked
+    them."""
+    if not cfg.disconnect_abort:
+        return
+    for k in [k for k in m['kv'] if k.startswith('seq/')
+              and k.endswith('/' + proc)]:
+        key = k.split('/')[1]
+        seq_close(m, key, proc)
+
+
+def _fenced(m, proc):
+    p = m['procs'][proc]
+    fk = p.get('fence_key')
+    return bool(fk) and m['counters'].get(fk, 0) > p.get('fence_gen', 0)
+
+
+# -- process roles --------------------------------------------------------
+
+def _writer_transitions(m, cfg, n, p):
+    """A chunked B* writer (BSET/BADD dense chunks or BSADD row
+    ranges — identical SeqFrame semantics; ``p['sparse']`` only labels
+    the frames). One 2-chunk sequence: the offset-0 frame, then the
+    final frame split into wire-entry and under-lock commit so the
+    fence-recheck window is explored."""
+    key = p['tkey']
+    kind = 'BSADD rows' if p['sparse'] else 'BSET chunk'
+    if p['wphase'] == 'w0':
+        def w0(m2, n=n):
+            p2 = m2['procs'][n]
+            if _fenced(m2, n):
+                # rejected at wire entry; an offset-0 frame opened
+                # nothing, so there is nothing to abort (HEAD) — but
+                # the pre-PR 1 rule aborts anyway
+                seq_abort_rejected(m2, cfg, key, n, 0)
+                p2['status'] = 'failed'
+                return
+            wid = '%s#%d' % (n, p2['wseq'])
+            seq_open_frame(m2, key, n, wid)
+            m2['kv']['t/%s/c0' % key] = wid
+            m2['counters']['t/%s/ver' % key] = _t_ver(m2, key) + 1
+            p2['wphase'] = 'w1e'
+        return [(n, 'writes %s 0 of write %s#%d (opens sequence, '
+                 'parity goes odd)' % (kind, n, p['wseq']), w0)]
+    if p['wphase'] == 'w1e':
+        def w1_entry(m2, n=n):
+            p2 = m2['procs'][n]
+            if _fenced(m2, n):
+                # rejected at wire entry: a continuation frame aborts
+                # the sequence it opened so readers are not wedged
+                seq_abort_rejected(m2, cfg, key, n, 1)
+                p2['status'] = 'failed'
+                return
+            p2['wphase'] = 'w1c'
+        return [(n, 'final %s of %s#%d passes the wire-entry fence '
+                 'check' % (kind, n, p['wseq']), w1_entry)]
+    if p['wphase'] == 'w1c':
+        def w1_commit(m2, n=n):
+            p2 = m2['procs'][n]
+            if _fenced(m2, n):
+                if cfg.fence_recheck == 'under_lock':
+                    # reject_fenced_under_tensor_lock: the re-check
+                    # under the tensor lock aborts the sequence
+                    seq_close(m2, key, n)
+                    p2['status'] = 'failed'
+                    return
+                # entry_only: the zombie frame commits anyway
+                _set_violation(
+                    m2, 'zombie-frame-commit',
+                    'the final %s of %s committed AFTER its fence '
+                    'bump: the wire-entry check alone leaves a window '
+                    '— B* handlers must re-check the fence under the '
+                    'tensor lock' % (kind, n))
+            wid = '%s#%d' % (n, p2['wseq'])
+            m2['kv']['t/%s/c1' % key] = wid
+            m2['counters']['t/%s/ver' % key] = _t_ver(m2, key) + 1
+            seq_close(m2, key, n)
+            p2['wseq'] += 1
+            if p2['wseq'] > p2['writes']:
+                p2['status'] = 'done'
+            else:
+                p2['wphase'] = 'w0'
+        return [(n, 'final %s of %s#%d commits (closes sequence, '
+                 'version bumps)' % (kind, n, p['wseq']), w1_commit)]
+    raise AssertionError(p['wphase'])
+
+
+def _malformed_transitions(m, cfg, n, p):
+    """A writer whose single offset-0 frame is malformed and rejected
+    before any SeqFrame exists (bad payload / bad range) — the PR 1
+    trigger."""
+    def reject(m2, n=n):
+        seq_abort_rejected(m2, cfg, p['tkey'], n, 0)
+        m2['procs'][n]['status'] = 'done'
+    return [(n, 'malformed offset-0 frame is rejected (ERR bad '
+             'payload)', reject)]
+
+
+def _reader_transitions(m, cfg, n, p):
+    """The coord_client torn-read loop over a 2-chunk versioned read:
+    accept only when both version snapshots are even and equal, else
+    retry. An accepted read that observed a chunk of a still-OPEN
+    sequence is the torn-read violation."""
+    key = p['tkey']
+    if p['rphase'] == 'r0':
+        def r0(m2, n=n):
+            p2 = m2['procs'][n]
+            p2['ver0'] = _t_parity(m2, key)
+            p2['saw0'] = m2['kv'].get('t/%s/c0' % key, 'init')
+            p2['rphase'] = 'r1'
+        return [(n, 'reads chunk 0 + version (BGET v)', r0)]
+
+    def r1(m2, n=n):
+        p2 = m2['procs'][n]
+        ver1 = _t_parity(m2, key)
+        saw1 = m2['kv'].get('t/%s/c1' % key, 'init')
+        if p2['ver0'] % 2 or ver1 % 2 or p2['ver0'] != ver1:
+            p2['rphase'] = 'r0'   # torn: retry (coord_client backoff)
+            return
+        # accepted as CLEAN: neither chunk may come from a sequence
+        # that is still open (aborted partial data is legitimate
+        # bounded-lag state; in-flight data is a torn read)
+        open_wids = {m2['kv'][k] for k in m2['kv']
+                     if k.startswith('seq/%s/' % key)}
+        for saw in (p2['saw0'], saw1):
+            if saw in open_wids:
+                _set_violation(
+                    m2, 'torn-read-clean',
+                    'reader %s accepted a CLEAN read (version even '
+                    'and stable) that observed chunk data of the '
+                    'still-open write sequence %s — the parity bit '
+                    'was cleared under the writer\'s feet' % (n, saw))
+        p2['status'] = 'done'
+    return [(n, 'reads chunk 1 + version; accept iff even and '
+             'unchanged', r1)]
+
+
+def _fencer_transitions(m, cfg, n, p):
+    """The exclude path's fence bump, abstracted to one transition
+    (its own ordering is protocol_model's domain): enabled only when
+    the target is stalled/crashed — the heartbeat-timeout ground-truth
+    abstraction."""
+    w = p['target']
+    st = m['procs'][w]['status']
+    ts = []
+    if not p['bumped'] and st in ('stalled', 'crashed'):
+        def bump(m2, n=n, w=w):
+            fk = m2['procs'][w]['fence_key']
+            m2['counters'][fk] = m2['counters'].get(fk, 0) + 1
+            m2['procs'][n]['bumped'] = True
+        ts.append((n, 'declares %s dead and bumps its fence '
+                   '(exclude path)' % w, bump))
+    if p['bumped'] or st in ('done', 'failed'):
+        def fin(m2, n=n):
+            m2['procs'][n]['status'] = 'done'
+        ts.append((n, 'fencer done', fin))
+    return ts
+
+
+# -- depth-2 pipeline ------------------------------------------------------
+
+def _pipe_transitions(m, cfg, n, p):
+    """One loose-mode worker at pipeline depth 2. Each RPC of the
+    run() loop and of the background job is its own transition:
+    join -> gate -> serve (prefetch or fresh pull) -> push -> publish
+    -> peer-floor scan -> pull-ahead -> next step. 'data/<w>' counters
+    are push counts (push -> publish order holds by construction, as
+    in the session); the prefetch record carries the floor it scanned
+    and the per-peer push counts its pull actually observed."""
+    s = p['step']
+    peers = [w for w in sorted(m['procs'])
+             if m['procs'][w]['role'] == 'pworker' and w != n]
+
+    if p['pphase'] == 'gate':
+        # join happened implicitly: the prefetch record is already in
+        # p (the bg job's transitions completed before run() proceeds
+        # — run() joins the pipeline first, so own-thread overlap
+        # never touches shared state)
+        target = s - cfg.staleness
+        steps = [m['counters'].get('step/%s' % w, 0)
+                 for w in sorted(m['procs'])
+                 if m['procs'][w]['role'] == 'pworker']
+        if target <= 0 or min(steps) >= target:
+            def gate(m2, n=n):
+                m2['procs'][n]['pphase'] = 'serve'
+            return [(n, 'gate passes (step %d)' % s, gate)]
+        return []   # blocked: MINWAIT (liveness catches deadlock)
+
+    if p['pphase'] == 'serve':
+        def serve(m2, n=n):
+            p2 = m2['procs'][n]
+            bound = p2['step'] - cfg.staleness
+            if p2['pf_floor'] >= 0:   # a prefetch is in hand
+                use = True
+                if cfg.prefetch_guard == 'floor_discard' and \
+                        p2['pf_floor'] < bound:
+                    use = False   # discard; the refetch is serial
+                if use:
+                    # the serial-staleness invariant: the served pull
+                    # must contain every peer push the gate guarantees
+                    observed = dict(p2['pf_seen'])
+                    for i, w in enumerate(peers):
+                        if observed.get(w, 0) < bound:
+                            _set_violation(
+                                m2, 'stale-prefetch',
+                                'worker %s served a prefetch at step '
+                                '%d whose pull observed only %d '
+                                'push(es) from %s (< the staleness '
+                                'bound %d the gate just guaranteed) '
+                                '— recorded floor %d let it through'
+                                % (n, p2['step'], observed.get(w, 0),
+                                   w, bound, p2['pf_floor']))
+                p2['pf_floor'] = -1
+                p2['pf_seen'] = ()
+            # fresh pull (or post-discard refetch) is an atomic read
+            # of current state: trivially within the bound
+            p2['pphase'] = 'push'
+        return [(n, 'serves the step-%d pull (prefetch or fresh)' % s,
+                 serve)]
+
+    if p['pphase'] == 'push':
+        def push(m2, n=n):
+            m2['counters']['data/%s' % n] = \
+                m2['counters'].get('data/%s' % n, 0) + 1
+            m2['procs'][n]['pphase'] = 'publish'
+        return [(n, 'bg: pushes step-%d delta' % s, push)]
+
+    if p['pphase'] == 'publish':
+        def publish(m2, n=n):
+            m2['counters']['step/%s' % n] = s
+            p2 = m2['procs'][n]
+            if p2['step'] >= cfg.steps:
+                p2['status'] = 'done'   # last step: no pull-ahead
+            elif cfg.floor_scan == 'after_publish':
+                p2['pphase'] = 'floor'
+            else:
+                p2['pphase'] = 'pull'
+        return [(n, 'bg: publishes step %d' % s, publish)]
+
+    if p['pphase'] == 'floor':
+        def floor(m2, n=n):
+            p2 = m2['procs'][n]
+            vals = [m2['counters'].get('step/%s' % w, 0)
+                    for w in peers] or [s]
+            p2['pf_floor'] = min(min(vals), s)
+            p2['pphase'] = 'pull' if cfg.floor_scan == \
+                'after_publish' else 'next'
+        return [(n, 'bg: scans peer step counters for the floor',
+                 floor)]
+
+    if p['pphase'] == 'pull':
+        def pull(m2, n=n):
+            p2 = m2['procs'][n]
+            p2['pf_seen'] = tuple(sorted(
+                (w, m2['counters'].get('data/%s' % w, 0))
+                for w in peers))
+            p2['pphase'] = 'next' if cfg.floor_scan == \
+                'after_publish' else 'floor'
+        return [(n, 'bg: pull-ahead snapshots peer state', pull)]
+
+    # 'next': advance to the next run() iteration
+    def nxt(m2, n=n):
+        p2 = m2['procs'][n]
+        p2['step'] += 1
+        p2['pphase'] = 'gate'
+    return [(n, 'run() returns; next step begins', nxt)]
+
+
+# -- telemetry cursor ------------------------------------------------------
+
+def _tpusher_transitions(m, cfg, n, p):
+    """push_records: the atomic counter bump lands BEFORE the tensor
+    write — two transitions, the real race window."""
+    if p['tphase'] == 'bump':
+        def bump(m2, n=n):
+            m2['counters']['tb'] = m2['counters'].get('tb', 0) + 1
+            m2['procs'][n]['tphase'] = 'write'
+        return [(n, 'push_records: bumps the batch counter (seq %d)'
+                 % (p['bseq'] + 1), bump)]
+
+    def write(m2, n=n):
+        p2 = m2['procs'][n]
+        p2['bseq'] += 1
+        m2['kv']['b%d' % p2['bseq']] = 'landed'
+        if p2['bseq'] >= p2['batches']:
+            p2['status'] = 'done'
+        else:
+            p2['tphase'] = 'bump'
+    return [(n, 'push_records: batch b%d bytes land' % (p['bseq'] + 1),
+             write)]
+
+
+def _collector_transitions(m, cfg, n, p):
+    """collect_new_records: read the counter, fetch cursor+1..n; the
+    advance rule is configuration. Mid-run polls are budgeted; the
+    close-time final sweep is enabled once the pusher is gone (close()
+    flushes and collects after joining the push lane)."""
+    pushers = [w for w in m['procs']
+               if m['procs'][w]['role'] == 'tpusher']
+    pusher_live = any(m['procs'][w]['status'] in ('running', 'stalled')
+                      for w in pushers)
+
+    def poll(m2, final, n=n):
+        p2 = m2['procs'][n]
+        cnt = m2['counters'].get('tb', 0)
+        consumed = p2['cursor']
+        for seq in range(p2['cursor'] + 1, cnt + 1):
+            if ('b%d' % seq) in m2['kv']:
+                consumed = seq
+                m2['kv']['consumed/b%d' % seq] = '1'
+            else:
+                # counter-bumped but not yet written
+                if cfg.cursor_advance == 'decoded_prefix':
+                    break   # retry from here next poll
+                consumed = seq   # pre-PR 11: skip it forever
+        p2['cursor'] = consumed
+        if final:
+            p2['status'] = 'done'
+
+    ts = []
+    if p['polls_left'] > 0:
+        def midpoll(m2, n=n):
+            m2['procs'][n]['polls_left'] -= 1
+            poll(m2, final=False)
+        ts.append((n, 'monitor poll (reads counter, fetches new '
+                   'batches)', midpoll))
+    if not pusher_live:
+        def finalpoll(m2, n=n):
+            poll(m2, final=True)
+        ts.append((n, 'close-time final sweep', finalpoll))
+    return ts
+
+
+def _telemetry_terminal_check(m):
+    """The no-permanent-skip invariant: every batch whose bytes landed
+    must have been consumed by the final sweep."""
+    problems = []
+    for k in sorted(m['kv']):
+        if k.startswith('b') and not k.startswith('b/') and \
+                m['kv'][k] == 'landed' and \
+                ('consumed/' + k) not in m['kv']:
+            problems.append((
+                'cursor-skip',
+                'batch %s landed (decodable) but the cursor skipped '
+                'it permanently — a poll racing the in-flight push '
+                'advanced past the gap and never came back' % k))
+    return problems
+
+
+# -- dispatch + stuck diagnosis -------------------------------------------
+
+_ROLES = {'dwriter': _writer_transitions,
+          'mwriter': _malformed_transitions,
+          'dreader': _reader_transitions,
+          'fencer': _fencer_transitions,
+          'pworker': _pipe_transitions,
+          'tpusher': _tpusher_transitions,
+          'collector': _collector_transitions}
+
+
+def proc_transitions(m, cfg, n):
+    p = m['procs'][n]
+    if p['status'] != 'running':
+        return []
+    return _ROLES[p['role']](m, cfg, n, p)
+
+
+def describe_stuck(m):
+    """Stall diagnosis for data-plane states: name any reader wedged
+    on odd parity (the PR 5 symptom) the way the admit-inversion
+    diagnosis names the invisible frozen counter."""
+    lines = []
+    for n in sorted(m['procs']):
+        p = m['procs'][n]
+        if p['status'] not in ('running', 'stalled'):
+            continue
+        if p['role'] == 'dreader':
+            key = p['tkey']
+            owners = sorted(
+                k.split('/')[2] for k in m['kv']
+                if k.startswith('seq/%s/' % key))
+            dead = [w for w in owners
+                    if m['procs'][w]['status'] in ('crashed', 'failed')]
+            if _t_open(m, key) > 0 and dead:
+                lines.append(
+                    'reader %s is WEDGED on key %s: version parity is '
+                    'stuck odd (open_writes=%d) because writer %s '
+                    'died mid-sequence and nothing aborted its open '
+                    'chunk sequence — every retry reads odd parity '
+                    'until a DELNS' % (n, key, _t_open(m, key),
+                                       ','.join(dead)))
+                continue
+        if p['role'] == 'pworker':
+            lines.append(
+                'worker %s is blocked at the step-%d gate'
+                % (n, p['step']))
+            continue
+        lines.append('%s is %s (role %s) with no enabled transition'
+                     % (n, p['status'], p['role']))
+    return '; '.join(lines) or 'no live process has an enabled ' \
+                               'transition'
+
+
+# -- scenario construction ------------------------------------------------
+
+def _base(procs, crash_budget=0):
+    return {'counters': {}, 'kv': {}, 'procs': procs,
+            'slot_owner': {}, 'crash_budget': crash_budget,
+            'violation': None}
+
+
+def _writer(n, key, writes=1, sparse=False):
+    return {'role': 'dwriter', 'status': 'running', 'tkey': key,
+            'wphase': 'w0', 'wseq': 1, 'writes': writes,
+            'sparse': sparse, 'fence_key': 'fence/' + n,
+            'fence_gen': 0, 'stall_budget': 0}
+
+
+def _reader(n, key):
+    return {'role': 'dreader', 'status': 'running', 'tkey': key,
+            'rphase': 'r0', 'ver0': 0, 'saw0': '', 'stall_budget': 0}
+
+
+def _scenario(name, cfg, model, **kw):
+    kw.setdefault('transitions_fn', proc_transitions)
+    kw.setdefault('describe_stuck', describe_stuck)
+    kw.setdefault('on_crash',
+                  lambda m, n: disconnect_abort(m, cfg, n))
+    return Scenario(name, cfg, model, **kw)
+
+
+def torn_write_scenario(cfg):
+    """One chunked writer, one malformed writer whose offset-0 frame
+    is rejected mid-flight, one versioned reader. PR 1's any-frame
+    abort must resurface as a torn-read-clean counterexample here."""
+    procs = {'A': _writer('A', 'T'),
+             'M': {'role': 'mwriter', 'status': 'running', 'tkey': 'T',
+                   'stall_budget': 0},
+             'R': _reader('R', 'T')}
+    return _scenario('torn_write', cfg, _base(procs))
+
+
+def writer_death_scenario(cfg):
+    """A chunked writer that may crash between any two frames (the
+    died-mid-push case every failure policy must survive) and a
+    versioned reader. PR 5's missing disconnect abort must resurface
+    as a stall naming the wedged reader."""
+    procs = {'A': _writer('A', 'T'), 'R': _reader('R', 'T')}
+    return _scenario('writer_death', cfg, _base(procs, crash_budget=1),
+                     crashable=('A',))
+
+
+def zombie_sparse_scenario(cfg):
+    """A row-sparse (BSADD) writer stalls mid-sequence, is declared
+    dead and fenced by the exclude path, then resumes its in-flight
+    final frame. HEAD's under-tensor-lock re-check must reject it
+    (and abort the sequence so the reader is not wedged); the
+    entry-only check lets the zombie frame commit."""
+    procs = {'A': _writer('A', 'T', sparse=True),
+             'E': {'role': 'fencer', 'status': 'running', 'target': 'A',
+                   'bumped': False, 'stall_budget': 0},
+             'R': _reader('R', 'T')}
+    return _scenario('zombie_sparse', cfg, _base(procs),
+                     stallable=('A',))
+
+
+def pipeline_scenario(cfg):
+    """Two loose-mode workers at pipeline depth 2 training
+    ``cfg.steps`` gated steps. The prefetch peer-floor guard and the
+    floor-scan position are the configuration under test; the
+    invariant is the serial staleness bound."""
+    procs = {}
+    for n in ('w0', 'w1'):
+        procs[n] = {'role': 'pworker', 'status': 'running', 'step': 1,
+                    'pphase': 'gate', 'pf_floor': -1, 'pf_seen': (),
+                    'stall_budget': 0}
+    return _scenario('pipeline', cfg, _base(procs))
+
+
+def telemetry_scenario(cfg):
+    """One span pusher (counter bump and batch write as separate
+    transitions, crashable between them) and the monitor's
+    incremental-cursor collector with budgeted mid-run polls plus the
+    close-time final sweep. PR 11's counter-advance rule must
+    resurface as a cursor-skip counterexample."""
+    procs = {'P': {'role': 'tpusher', 'status': 'running',
+                   'tphase': 'bump', 'bseq': 0, 'batches': 2,
+                   'stall_budget': 0},
+             'C': {'role': 'collector', 'status': 'running',
+                   'cursor': 0, 'polls_left': cfg.polls,
+                   'stall_budget': 0}}
+    return _scenario('telemetry', cfg, _base(procs, crash_budget=1),
+                     crashable=('P',),
+                     terminal_check=_telemetry_terminal_check)
+
+
+def scenarios(cfg):
+    """The standard data-plane scenario suite for one configuration."""
+    return [torn_write_scenario(cfg), writer_death_scenario(cfg),
+            zombie_sparse_scenario(cfg), pipeline_scenario(cfg),
+            telemetry_scenario(cfg)]
+
+
+#: Each seeded pre-fix ordering must yield its counterexample in the
+#: named scenario — the sensitivity guard, exactly like the
+#: control-plane checker's (PR4_RESURRECTION et al.).
+SEEDED_BUGS = (
+    ('PR1 offset-0 abort closes another writer\'s sequence',
+     PR1_OFFSET0_ABORT, 'torn_write', 'torn-read-clean'),
+    ('PR5 disconnect leaves the sequence open (reader wedge)',
+     PR5_DISCONNECT_WEDGE, 'writer_death', 'stall'),
+    ('PR11 cursor advances past an in-flight batch',
+     PR11_CURSOR_RACE, 'telemetry', 'cursor-skip'),
+    ('fence checked at wire entry only (zombie frame commits)',
+     UNLOCKED_FENCE_RECHECK, 'zombie_sparse', 'zombie-frame-commit'),
+    ('prefetch served without the peer-floor discard',
+     NO_FLOOR_DISCARD, 'pipeline', 'stale-prefetch'),
+    ('peer floor scanned after the pull-ahead it must lower-bound',
+     FLOOR_AFTER_PULL, 'pipeline', 'stale-prefetch'),
+)
+
+#: Exploration statistics of the last :func:`analyze` run.
+LAST_STATS = {}
+
+
+def analyze():
+    """The data-plane analyzer: HEAD explores clean on every scenario
+    AND every seeded pre-fix ordering still counterexamples. Returns
+    finding strings (empty = clean)."""
+    from autodist_tpu.analysis import explore
+    LAST_STATS.clear()
+    return explore.run_suite(HEAD, scenarios, SEEDED_BUGS,
+                             'data-plane model', stats=LAST_STATS)

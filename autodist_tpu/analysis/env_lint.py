@@ -1,7 +1,7 @@
 """Env-knob lint: no undeclared ``AUTODIST_*`` reads, no silently
-unforwarded knobs.
+unforwarded knobs, no docs drift.
 
-Two invariants over the whole tree:
+Three invariants over the whole tree:
 
 1. **Declaration** — every ``AUTODIST_*`` environment read (Python
    ``os.environ[...]``/``os.environ.get``/``os.getenv``, C++
@@ -18,6 +18,14 @@ Two invariants over the whole tree:
    only, security transport, explicit-install chaos knobs). A knob in
    neither set is a finding: an operator exporting it on the chief
    would silently configure only the chief.
+3. **Documentation** — every ``AUTODIST_*`` ENV member must be
+   mentioned somewhere under ``docs/`` (the generated ``docs/api/``
+   pages don't count: they mirror docstrings, so they can't catch a
+   knob the hand-written docs forgot — ``docs/usage/env-knobs.md`` is
+   the catch-all reference), and a choice-validated knob
+   (``_choice`` in const.py, e.g. ``AUTODIST_STRAGGLER_POLICY``) must
+   enumerate the SAME choice set in the docs near its mention —
+   findings name the knob and the missing/stale side.
 
 Writes (``os.environ[k] = v``, ``.setdefault``, ``.pop``, ``del``,
 ``monkeypatch.setenv``) are not reads and are ignored.
@@ -130,13 +138,181 @@ def declared_env():
     return {e.name for e in ENV}
 
 
+#: Hand-written docs roots the documentation invariant scans;
+#: ``docs/api`` is excluded on purpose (generated from docstrings —
+#: it cannot catch a knob the written docs forgot).
+DOCS_EXCLUDE = ('api',)
+
+
+def docs_text(root=None):
+    """Concatenated hand-written docs (``docs/**/*.md|rst`` minus the
+    generated API pages)."""
+    root = root or os.path.join(REPO, 'docs')
+    chunks = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        if dirpath == root:
+            # only the TOP-LEVEL docs/api is generated; a hand-written
+            # nested dir that happens to be named 'api' still counts
+            dirnames[:] = [d for d in dirnames if d not in DOCS_EXCLUDE]
+        for fn in sorted(filenames):
+            if fn.endswith(('.md', '.rst')):
+                with open(os.path.join(dirpath, fn),
+                          encoding='utf-8', errors='replace') as f:
+                    chunks.append(f.read())
+    return '\n'.join(chunks)
+
+
+def choice_sets(src=None):
+    """``{knob: (choices...)}`` for every ``_choice``-validated ENV
+    member, parsed from const.py's AST (robust to quoting, the lambda
+    parameter name, and call formatting — a regex here once meant a
+    reformatted call silently dropped its knob from the invariant).
+    A ``_choice`` call whose name or choice tuple is not a static
+    literal maps to ``None``, which :func:`check_docs` reports as a
+    finding instead of silently skipping the knob."""
+    import ast
+    if src is None:
+        src_path = os.path.join(REPO, 'autodist_tpu', 'const.py')
+        with open(src_path, encoding='utf-8') as f:
+            src = f.read()
+    out = {}
+    for node in ast.walk(ast.parse(src)):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == '_choice'):
+            continue
+        name = node.args[0] if node.args else None
+        allowed = node.args[3] if len(node.args) > 3 else None
+        name = name.value if (isinstance(name, ast.Constant)
+                              and isinstance(name.value, str)) else None
+        if allowed is not None and isinstance(
+                allowed, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in allowed.elts):
+            choices = tuple(e.value for e in allowed.elts)
+        else:
+            choices = None
+        if name is None:
+            # a dynamic knob name: surface it under a sentinel so the
+            # lint still complains instead of skipping the call
+            name = '<dynamic _choice call at line %d>' % node.lineno
+            choices = None
+        out[name] = choices
+    return out
+
+
+def _doc_windows(docs, knob, radius=700):
+    """Text windows around every docs mention of ``knob`` — the
+    neighborhood a choice enumeration must live in."""
+    wins = []
+    for m in re.finditer(re.escape(knob), docs):
+        wins.append(docs[max(0, m.start() - radius):
+                         m.end() + radius])
+    return wins
+
+
+#: An enumeration-looking token run in PROSE: words separated by ``/``
+#: or ``|``, with optional backticks.
+_ENUM = re.compile(r'`?(\w+)`?(?:\s*[/|]\s*`?(\w+)`?)+')
+#: The same inside one markdown TABLE CELL, where a bare ``|`` is the
+#: cell delimiter and a literal pipe separator is escaped as ``\|``.
+_ENUM_CELL = re.compile(r'`?(\w+)`?(?:\s*(?:/|\\\|)\s*`?(\w+)`?)+')
+
+
+def _enum_runs(blob):
+    """Enumeration-looking token runs in ``blob``, table-aware: on a
+    markdown table row the scan runs per CELL (a bare ``|`` delimits
+    cells there, so a run must not chain across the boundary and
+    swallow the next cell's first word as a phantom choice)."""
+    out = []
+    for line in blob.splitlines():
+        if line.lstrip().startswith('|'):
+            for cell in re.split(r'(?<!\\)\|', line):
+                out.extend(m.group(0)
+                           for m in _ENUM_CELL.finditer(cell))
+        else:
+            out.extend(m.group(0) for m in _ENUM.finditer(line))
+    return out
+
+
+def check_docs(declared=None, choices=None, docs=None):
+    """The documentation invariant. Returns finding strings (empty =
+    clean). ``declared``/``choices``/``docs`` are injectable for
+    tests."""
+    findings = []
+    declared = declared if declared is not None else declared_env()
+    choices = choices if choices is not None else choice_sets()
+    docs = docs if docs is not None else docs_text()
+    for name in sorted(declared):
+        if not name.startswith('AUTODIST_'):
+            continue    # SYS_* reference-parity paths judged by hand
+        # word-bounded: a mention of AUTODIST_TELEMETRY_DIR must not
+        # satisfy AUTODIST_TELEMETRY (the registry has real prefix
+        # pairs)
+        if not re.search(r'\b%s\b' % re.escape(name), docs):
+            findings.append(
+                'env knob %s is registered in const.py ENV but never '
+                'mentioned under docs/ (generated api/ pages '
+                'excluded) — missing side: docs '
+                '(docs/usage/env-knobs.md is the catch-all reference)'
+                % name)
+    for knob, allowed in sorted(choices.items()):
+        if allowed is None:
+            findings.append(
+                'choice knob %s: const.py\'s choice set is not a '
+                'static literal — the docs-sync invariant cannot '
+                'verify it (make the _choice call name the knob and '
+                'its tuple of string literals inline)' % knob)
+            continue
+        wins = _doc_windows(docs, knob)
+        if not wins:
+            continue    # already reported as undocumented above
+        blob = '\n'.join(wins)
+        for choice in allowed:
+            if not re.search(r'\b%s\b' % re.escape(choice), blob):
+                findings.append(
+                    'choice knob %s: docs near its mention never name '
+                    'the choice %r — missing side: docs (the '
+                    'validator in const.py accepts %s)'
+                    % (knob, choice, '|'.join(allowed)))
+        # a docs enumeration that names 2+ real choices IS the choice
+        # list; any extra member of it is stale on the docs side.
+        # Judge only enum runs on LINES that mention this knob — the
+        # ±700-char windows reach into neighboring knobs' rows, and a
+        # neighbor sharing 2+ choice tokens (off/warn/... are common)
+        # must not get its own valid choices flagged as this knob's
+        # stale ones. One finding per stale token: mention lines can
+        # repeat across overlapping windows.
+        bound = re.compile(r'\b%s\b' % re.escape(knob))
+        knob_lines = '\n'.join(
+            ln for ln in blob.splitlines() if bound.search(ln))
+        stale = set()
+        for run in _enum_runs(knob_lines):
+            # only lowercase word tokens can be choice values (knob
+            # names and surrounding prose are not), so judge only those
+            toks = [t for t in re.split(r'[^\w]+', run)
+                    if t and re.fullmatch(r'[a-z][a-z0-9_]*', t)]
+            hits = [t for t in toks if t in allowed]
+            if len(set(hits)) < 2:
+                continue
+            stale.update(t for t in toks if t not in allowed)
+        for t in sorted(stale):
+            findings.append(
+                'choice knob %s: docs enumerate choice %r, '
+                'which const.py\'s validator does not accept '
+                '(%s) — stale side: docs'
+                % (knob, t, '|'.join(allowed)))
+    return findings
+
+
 def forwarded_env():
     from autodist_tpu.runtime.coordinator import _FORWARDED_FLAGS
     return {e.name for e in _FORWARDED_FLAGS}
 
 
 def analyze(files=None):
-    """Run both invariants. Returns finding strings (empty = clean)."""
+    """Run all three invariants. Returns finding strings (empty =
+    clean)."""
     findings = []
     declared = declared_env()
     for relpath, lineno, name in raw_reads(files):
@@ -175,4 +351,6 @@ def analyze(files=None):
         findings.append(
             'env_lint.FORWARD_EXEMPT lists %s, which is not an ENV '
             'member — stale exemption' % name)
+    if files is None:   # doctored-source probes lint only their files
+        findings.extend(check_docs(declared=declared))
     return findings

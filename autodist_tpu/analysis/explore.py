@@ -66,7 +66,7 @@ def _transitions(m, sc):
     for n in sorted(m['procs']):
         p = m['procs'][n]
         if p['status'] == 'running':
-            ts.extend(pm.proc_transitions(m, sc.cfg, n))
+            ts.extend(sc.transitions_fn(m, sc.cfg, n))
         elif p['status'] == 'stalled':
             def resume(m2, n=n):
                 m2['procs'][n]['status'] = 'running'
@@ -77,6 +77,11 @@ def _transitions(m, sc):
                 def crash(m2, n=n):
                     m2['procs'][n]['status'] = 'crashed'
                     m2['crash_budget'] -= 1
+                    # model-specific death side effects (e.g. the
+                    # service's disconnect-time SeqAborter: a dead
+                    # connection's open chunk sequences are aborted)
+                    if sc.on_crash is not None:
+                        sc.on_crash(m2, n)
                 ts.append((n, 'CRASHES', crash))
     for n in sc.stallable:
         p = m['procs'][n]
@@ -227,10 +232,11 @@ def explore(sc, max_states=500000):
             # BFS insertion order makes parents-paths shortest; take
             # the earliest-discovered stuck state for the tightest trace
             k = min(stuck, key=lambda k: len(_path(parents, k)))
+            describe = sc.describe_stuck or _describe_stuck
             violations['stall'] = Violation(
                 'stall', _path(parents, k),
                 'no good terminal state is reachable from here: ' +
-                _describe_stuck(states[k]))
+                describe(states[k]))
     vs = sorted(violations.values(), key=lambda v: v.kind)
     return Result(scenario=sc.name, ok=not vs, violations=vs,
                   states=len(states), terminals=len(terminal_good))
@@ -268,25 +274,60 @@ SEEDED_BUGS = (
 )
 
 
+#: Exploration statistics of the last :func:`analyze` run (or any
+#: model-checker pass using :func:`run_suite`): per-scenario and total
+#: states explored, so ``tools/analyze.py --json`` can report model
+#: cost and ``bench_compare`` can flag state-space blowup.
+LAST_STATS = {}
+
+
+def run_suite(head_cfg, scenarios_fn, seeded, label, stats=None,
+              max_states=500000):
+    """The shared both-directions analyzer every model checker runs:
+    the HEAD configuration must explore clean across the whole
+    scenario suite, AND every seeded pre-fix ordering must still
+    produce its counterexample (the sensitivity guard). ``seeded`` is
+    an iterable of ``(name, cfg, scenario_name, violation_kind)``.
+    Fills ``stats`` (a dict) with per-scenario/total states explored.
+    Returns finding strings (empty = clean)."""
+    findings = []
+    per_scenario = {}
+    for sc in scenarios_fn(head_cfg):
+        result = explore(sc, max_states=max_states)
+        per_scenario[sc.name] = result.states
+        for v in result.violations:
+            findings.append(
+                '%s: HEAD ordering has a counterexample (%s)\n%s'
+                % (label, v.kind, format_violation(result, v)))
+    for name, cfg, scen_name, kind in seeded:
+        sc = {s.name: s for s in scenarios_fn(cfg)}[scen_name]
+        result = explore(sc, max_states=max_states)
+        # unique stats key per seeded exploration: two seeds sharing a
+        # scenario+kind (e.g. both pipeline floor bugs) must both show
+        # up, or a state-space blowup in the second is invisible to
+        # the bench_compare gate these counts feed
+        key = '%s[%s]' % (scen_name, kind)
+        while key in per_scenario:
+            key += "'"
+        per_scenario[key] = result.states
+        if kind not in result.kinds():
+            findings.append(
+                '%s: seeded bug %r no longer yields a %r '
+                'counterexample in scenario %r (found: %s) — the model '
+                'lost the sensitivity that justifies its clean HEAD '
+                'run' % (label, name, kind, scen_name,
+                         result.kinds() or 'none'))
+    if stats is not None:
+        stats['scenarios'] = per_scenario
+        stats['states_explored'] = sum(per_scenario.values())
+    return findings
+
+
 def analyze():
     """The protocol-model analyzer: HEAD's orderings must explore clean
     across the whole scenario suite, AND every seeded pre-fix ordering
     must still produce its counterexample. Returns finding strings
     (empty = clean)."""
-    findings = []
-    for result in check_all(pm.HEAD):
-        for v in result.violations:
-            findings.append(
-                'protocol model: HEAD ordering has a counterexample '
-                '(%s)\n%s' % (v.kind, format_violation(result, v)))
-    for name, cfg, scen_name, kind in SEEDED_BUGS:
-        sc = {s.name: s for s in pm.scenarios(cfg)}[scen_name]
-        result = explore(sc)
-        if kind not in result.kinds():
-            findings.append(
-                'protocol model: seeded bug %r no longer yields a %r '
-                'counterexample in scenario %r (found: %s) — the model '
-                'lost the sensitivity that justifies its clean HEAD '
-                'run' % (name, kind, scen_name,
-                         result.kinds() or 'none'))
-    return findings
+    LAST_STATS.clear()
+    return run_suite(pm.HEAD, pm.scenarios, SEEDED_BUGS,
+                     'protocol model', stats=LAST_STATS)

@@ -1,4 +1,5 @@
-"""Fence-coverage lint over the native coord-service dispatcher.
+"""Fence-coverage + payload-bound lint over the native coord-service
+dispatcher.
 
 Statically parses ``native/coord_service.cc`` and proves, per
 dispatched command, the writer-fencing contract the elastic-recovery
@@ -9,8 +10,21 @@ must ALSO re-check under the tensor lock
 (``reject_fenced_under_tensor_lock``) so one in-flight zombie frame
 cannot commit after its fence bump.
 
-The classification table below is the lint's ground truth: a command
-the dispatcher matches that appears in NEITHER table is a finding —
+It also generalizes the PR 5 BGETROWS hardening into a rule: every
+command whose header DECLARES a size (payload bytes to buffer, or
+reply dimensions to allocate) must bound that declaration against
+``kMaxPayload`` BEFORE any buffer is sized from it — an unvalidated
+product can ``bad_alloc`` (or wrap ``size_t``) and kill the whole
+control plane. Request-side declarations are bounded in
+``payload_size()`` (returning ``kBadPayload``); reply-side
+declarations are bounded inside the command's own dispatcher block.
+The :data:`PAYLOAD_BOUNDED` table is the ground truth; a dispatcher
+block that touches the request ``payload`` without a table entry is a
+finding, so a new payload-bearing command forces an explicit bounding
+decision.
+
+The classification tables below are the lint's ground truth: a
+command the dispatcher matches that appears in NO table is a finding —
 adding a protocol command forces an explicit fencing decision here
 (and a model-checker look; see ``docs/design/static-analysis.md``).
 
@@ -71,6 +85,21 @@ ALLOWED_UNFENCED = {
 #: appear in the dispatcher.
 HANDSHAKE_ONLY = {'AUTH'}
 
+#: Commands whose header line declares a size. 'request' = the
+#: declared payload bytes are buffered before handle() runs, so the
+#: bound must live in ``payload_size()`` (return ``kBadPayload`` past
+#: ``kMaxPayload``); 'reply' = the block allocates a reply buffer from
+#: declared dimensions, so the bound must live in the block itself
+#: (the PR 5 BGETROWS fix: a 256 GB nrows*ncols declaration must be
+#: refused before the allocation, not discovered as bad_alloc).
+PAYLOAD_BOUNDED = {
+    'BSET': ('request',),
+    'BADD': ('request',),
+    'BSTEP': ('request',),
+    'BSADD': ('request',),
+    'BGETROWS': ('request', 'reply'),
+}
+
 
 def _read(text=None):
     if text is None:
@@ -99,10 +128,10 @@ def header_fenced_commands(text):
     return set(re.findall(r'\b([A-Z][A-Z0-9]*)\b', m.group(1)))
 
 
-def _handle_body(text):
-    """The body of the ``handle()`` function (the dispatcher) — scoped
-    so ``payload_size``'s own ``cmd ==`` matches don't alias."""
-    m = re.search(r'std::string handle\(', text)
+def _fn_body(text, pattern):
+    """The balanced-brace body of the first function whose signature
+    matches ``pattern``, or None."""
+    m = re.search(pattern, text)
     if not m:
         return None
     i = text.index('{', m.end())
@@ -115,6 +144,38 @@ def _handle_body(text):
             if depth == 0:
                 return text[i:j + 1]
     return None
+
+
+def _handle_body(text):
+    """The body of the ``handle()`` function (the dispatcher) — scoped
+    so ``payload_size``'s own ``cmd ==`` matches don't alias."""
+    return _fn_body(text, r'std::string handle\(')
+
+
+def payload_size_branches(text):
+    """``{command: branch source}`` inside ``payload_size()`` — the
+    function that decides how many request-payload bytes to buffer
+    from a header declaration. A branch runs from the first line
+    mentioning the command to the next command's first line (commands
+    sharing one guard line — the BSET/BADD/BSTEP tail — share the
+    remainder). None when the function is missing."""
+    body = _fn_body(text, r'size_t payload_size\(')
+    if body is None:
+        return None
+    by_line = {}
+    for m in re.finditer(r'cmd [=!]= "([A-Z][A-Z0-9]*)"', body):
+        ls = body.rfind('\n', 0, m.start()) + 1
+        by_line.setdefault(ls, []).append(m.group(1))
+    first = {}
+    for ls in sorted(by_line):
+        for cmd in by_line[ls]:
+            first.setdefault(cmd, ls)
+    starts = sorted(set(first.values()))
+    out = {}
+    for cmd, ls in first.items():
+        nxt = [s for s in starts if s > ls]
+        out[cmd] = body[ls:nxt[0] if nxt else len(body)]
+    return out
 
 
 def dispatched_blocks(text):
@@ -169,6 +230,65 @@ def find_drift(text=None):
     return problems
 
 
+def _strip_comments(src):
+    """Drop ``//`` line and ``/* */`` block comments: a bound that
+    exists only in prose must not satisfy the lint."""
+    src = re.sub(r'/\*.*?\*/', '', src, flags=re.S)
+    return re.sub(r'//[^\n]*', '', src)
+
+
+def check_payload_bounds(text, blocks=None):
+    """The generalized PR 5 hardening: every size-declaring command's
+    declared allocation is bounded against ``kMaxPayload`` before any
+    buffer is sized from it, and every dispatcher block that touches
+    the request ``payload`` carries a :data:`PAYLOAD_BOUNDED` entry.
+    Returns finding strings (empty = clean)."""
+    if blocks is None:
+        blocks = dispatched_blocks(text)
+    findings = []
+    branches = payload_size_branches(text)
+    for cmd in sorted(set(PAYLOAD_BOUNDED) - set(blocks)):
+        findings.append(
+            'coord_service.cc: %s is classified in '
+            'analysis/fence_lint.py PAYLOAD_BOUNDED but no longer '
+            'dispatched — stale table entry' % cmd)
+    for cmd in sorted(set(PAYLOAD_BOUNDED) & set(blocks)):
+        roles = PAYLOAD_BOUNDED[cmd]
+        if 'request' in roles:
+            if branches is None or cmd not in branches:
+                findings.append(
+                    'coord_service.cc: %s declares a request payload '
+                    'size but payload_size() never sizes it — the '
+                    'declared bytes are buffered unbounded' % cmd)
+            else:
+                seg = _strip_comments(branches[cmd])
+                if 'kMaxPayload' not in seg or 'kBadPayload' not in seg:
+                    findings.append(
+                        'coord_service.cc: %s\'s request-size '
+                        'declaration is not bounded against '
+                        'kMaxPayload (with a kBadPayload refusal) in '
+                        'payload_size() before the bytes are buffered '
+                        '— an unvalidated declaration can bad_alloc/'
+                        'wrap and kill the whole control plane' % cmd)
+        if 'reply' in roles and \
+                'kMaxPayload' not in _strip_comments(blocks[cmd]):
+            findings.append(
+                'coord_service.cc: %s allocates a reply from declared '
+                'dimensions without bounding them against kMaxPayload '
+                'inside its dispatcher block (the PR 5 BGETROWS '
+                'hardening: refuse the declaration, don\'t discover '
+                'it as bad_alloc)' % cmd)
+    for cmd in sorted(set(blocks) - set(PAYLOAD_BOUNDED)):
+        if re.search(r'\bpayload\b', _strip_comments(blocks[cmd])):
+            findings.append(
+                'coord_service.cc: dispatched command %s touches the '
+                'request payload but is not classified in '
+                'analysis/fence_lint.py PAYLOAD_BOUNDED — a new '
+                'payload-bearing command needs an explicit '
+                'size-bounding decision' % cmd)
+    return findings
+
+
 def analyze(text=None):
     """Full fence-coverage lint. Returns finding strings (empty =
     clean)."""
@@ -210,6 +330,7 @@ def analyze(text=None):
                 're-check the fence under the tensor lock '
                 '(reject_fenced_under_tensor_lock) — one in-flight '
                 'zombie frame could commit after its fence bump' % cmd)
+    findings.extend(check_payload_bounds(text, blocks))
     hdr = header_fenced_commands(text)
     if hdr is None:
         findings.append(

@@ -114,16 +114,37 @@ UNRETIRED_CAP_RACE = replace(HEAD, retire_on_cap_race=False)
 class Scenario:
     """One bounded system to explore: an initial model state plus the
     crash/stall choices the explorer may inject and an optional
-    ``terminal_check(model) -> [(kind, msg)]`` terminal invariant."""
+    ``terminal_check(model) -> [(kind, msg)]`` terminal invariant.
+
+    The explorer (:mod:`~autodist_tpu.analysis.explore`) is model-
+    agnostic as long as the state dict keeps the shared shape
+    (``counters``/``kv``/``procs``/``slot_owner``/``crash_budget``/
+    ``violation`` with hashable values); which model a scenario speaks
+    is carried by three hooks:
+
+    - ``transitions_fn(model, cfg, proc) -> [(actor, label, fn)]`` —
+      the per-process transition generator (defaults to this module's
+      :func:`proc_transitions`);
+    - ``on_crash(model, proc)`` — side effects of an injected crash
+      beyond ``status='crashed'`` (the data-plane model uses it for
+      the service's disconnect-time ``SeqAborter``);
+    - ``describe_stuck(model) -> str`` — the stall diagnosis (defaults
+      to the control-plane one, which names invisible frozen step
+      counters in the gate prefix-min).
+    """
 
     def __init__(self, name, cfg, model, crashable=(), stallable=(),
-                 terminal_check=None):
+                 terminal_check=None, transitions_fn=None,
+                 on_crash=None, describe_stuck=None):
         self.name = name
         self.cfg = cfg
         self.model = model
         self.crashable = tuple(crashable)
         self.stallable = tuple(stallable)
         self.terminal_check = terminal_check
+        self.transitions_fn = transitions_fn or proc_transitions
+        self.on_crash = on_crash
+        self.describe_stuck = describe_stuck
 
 
 # -- service semantics ----------------------------------------------------

@@ -2043,6 +2043,46 @@ def _sim_drift(simulator_block):
             'worst_ratio': round(max(max(raw), 1.0 / min(raw)), 4)}
 
 
+def bench_analysis():
+    """The static-analysis trajectory block (stable BENCH key
+    ``analysis``): run ``tools/analyze.py --all --json`` in a
+    subprocess (its own interpreter — the analyzers import the tree
+    fresh and must not inherit bench's jax state) and record per-pass
+    wall time and, for the model checkers, states explored — so
+    ``tools/bench_compare.py`` can flag analyzer-cost and state-space
+    blowup regressions between records. Degrades to an ``error`` field
+    instead of failing the bench record."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [_sys.executable, os.path.join(repo, 'tools', 'analyze.py'),
+             '--all', '--json'],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+        report = json.loads(r.stdout)
+    except Exception as e:  # noqa: BLE001 - accounting is best-effort
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+    out = {
+        'schema_version': report.get('schema_version'),
+        'clean': report.get('clean'),
+        'findings': report.get('findings'),
+        'total_elapsed_s': round(time.monotonic() - t0, 3),
+        'passes': {},
+        'states_explored_total': 0,
+    }
+    for name, rec in (report.get('analyzers') or {}).items():
+        entry = {'elapsed_s': rec.get('elapsed_s'),
+                 'findings': len(rec.get('findings') or [])}
+        if 'states_explored' in rec:
+            entry['states_explored'] = rec['states_explored']
+            out['states_explored_total'] += rec['states_explored']
+        out['passes'][name] = entry
+    return out
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -2176,6 +2216,7 @@ def main():
             result['extra']['simulator'])
         result['extra']['telemetry'] = telemetry_rec
         result['extra']['monitor'] = bench_monitor()
+        result['extra']['analysis'] = bench_analysis()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -2200,6 +2241,7 @@ def main():
     # the observe-then-verify loop calibrate.py refits against
     telemetry_rec['sim_drift'] = _sim_drift(simulator)
     monitor_rec = bench_monitor()
+    analysis_rec = bench_analysis()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -2223,6 +2265,7 @@ def main():
                 'hierarchical': hierarchical,
                 'telemetry': telemetry_rec,
                 'monitor': monitor_rec,
+                'analysis': analysis_rec,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -2281,7 +2324,8 @@ def main():
                       'quantized': quantized,
                       'hierarchical': hierarchical,
                       'telemetry': telemetry_rec,
-                      'monitor': monitor_rec},
+                      'monitor': monitor_rec,
+                      'analysis': analysis_rec},
         }
     print(json.dumps(result))
 

@@ -1,8 +1,13 @@
-"""The static-analysis subsystem (ISSUE 10): the control-plane model
-checker re-derives the two costliest historical protocol bugs as
-counterexample traces and explores HEAD's orderings clean; the fence /
-env / schedule lints are pinned positive on HEAD and negative against
-doctored inputs; ``tools/analyze.py --all`` is the tier-1 wiring.
+"""The static-analysis subsystem (ISSUE 10 + ISSUE 13): the control-
+plane model checker re-derives the two costliest historical protocol
+bugs as counterexample traces and explores HEAD's orderings clean; the
+data-plane checker does the same for the PR 1 offset-0 abort, the
+PR 5 disconnect wedge and the PR 11 telemetry-cursor race; the
+epoch-swap model proves the ROADMAP 2 handshake contract (verified
+ordering clean, tempting-but-wrong orderings counterexample); the
+fence / env / schedule lints are pinned positive on HEAD and negative
+against doctored inputs; ``tools/analyze.py --all`` is the tier-1
+wiring.
 """
 import json
 import os
@@ -100,6 +105,207 @@ def test_model_self_test_guards_sensitivity():
         explore.SEEDED_BUGS = saved
 
 
+# -- data-plane model checker (ISSUE 13) ----------------------------------
+
+def _dp_scenario(cfg, name):
+    from autodist_tpu.analysis import data_plane_model as dp
+    return {s.name: s for s in dp.scenarios(cfg)}[name]
+
+
+def test_data_plane_head_explores_clean():
+    """Every data-plane scenario under HEAD's semantics: no torn read
+    surfaces clean, no zombie frame commits, no stale prefetch is
+    served, no decodable batch is skipped — across every interleaving
+    including crashes — and every reader/worker can always finish."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    results = [explore.explore(sc) for sc in dp.scenarios(dp.HEAD)]
+    assert {r.scenario for r in results} == {
+        'torn_write', 'writer_death', 'zombie_sparse', 'pipeline',
+        'telemetry'}
+    for r in results:
+        assert r.ok, '\n'.join(explore.format_violation(r, v)
+                               for v in r.violations)
+        assert r.terminals > 0
+        assert r.states > 20
+
+
+def test_data_plane_rederives_pr1_offset0_abort():
+    """Golden trace: flipping abort_open_seq back to any-frame (the
+    pre-PR 1 rule) re-derives the torn read — a malformed offset-0
+    frame clears another writer's parity bit and a reader accepts
+    half-written data as clean."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.PR1_OFFSET0_ABORT,
+                                     'torn_write'))
+    assert 'torn-read-clean' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'torn-read-clean'][0]
+    text = explore.format_violation(r, v)
+    print('\n' + text)
+    # the trace is a numbered event sequence with the exact mechanism
+    assert text.splitlines()[1].strip().startswith('1.')
+    assert 'malformed offset-0 frame is rejected' in text
+    assert 'opens sequence, parity goes odd' in text
+    assert 'still-open write sequence' in v.diagnosis
+    # and the malformed frame lands BEFORE the accept
+    labels = [label for _, label in v.trace]
+    assert labels.index('malformed offset-0 frame is rejected (ERR '
+                        'bad payload)') < len(labels) - 1
+
+
+def test_data_plane_rederives_pr5_disconnect_wedge():
+    """Golden trace + the liveness diagnosis: without the disconnect-
+    time SeqAborter, a writer killed between chunks wedges the reader
+    on odd parity forever — and the stall diagnosis NAMES the wedged
+    reader and the stuck-odd key, the way the admit-inversion
+    diagnosis names the invisible frozen counter."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.PR5_DISCONNECT_WEDGE,
+                                     'writer_death'))
+    assert 'stall' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'stall'][0]
+    text = explore.format_violation(r, v)
+    print('\n' + text)
+    assert any('CRASHES' in label for _, label in v.trace)
+    assert 'reader R is WEDGED on key T' in v.diagnosis
+    assert 'stuck odd' in v.diagnosis
+    assert 'died mid-sequence' in v.diagnosis
+    # HEAD's SeqAborter heals exactly this: same scenario, no stall
+    r2 = explore.explore(_dp_scenario(dp.HEAD, 'writer_death'))
+    assert r2.ok, r2.kinds()
+
+
+def test_data_plane_rederives_pr11_cursor_race():
+    """Golden trace: the counter-advance cursor rule re-derives the
+    telemetry batch drop — a poll racing the bump-then-write window
+    skips the in-flight batch forever."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.PR11_CURSOR_RACE, 'telemetry'))
+    assert 'cursor-skip' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'cursor-skip'][0]
+    text = explore.format_violation(r, v)
+    print('\n' + text)
+    labels = [label for _, label in v.trace]
+    # the racing poll lands between the counter bump and the write
+    bump = next(i for i, l in enumerate(labels) if 'bumps the batch '
+                'counter' in l)
+    land = next(i for i, l in enumerate(labels) if 'bytes land' in l)
+    polls = [i for i, l in enumerate(labels) if 'monitor poll' in l]
+    assert any(bump < i < land for i in polls), labels
+    assert 'skipped it permanently' in v.diagnosis
+
+
+def test_data_plane_extra_seeded_orderings():
+    """The non-historical seeded orderings of the same classes: the
+    entry-only fence check lets a zombie BSADD frame commit; serving
+    a prefetch without the floor discard (or scanning the floor after
+    the pull it must lower-bound) violates the serial staleness
+    bound."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.UNLOCKED_FENCE_RECHECK,
+                                     'zombie_sparse'))
+    assert 'zombie-frame-commit' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'zombie-frame-commit'][0]
+    assert any('BSADD' in label for _, label in v.trace)
+    assert any('bumps its fence' in label for _, label in v.trace)
+    for cfg in (dp.NO_FLOOR_DISCARD, dp.FLOOR_AFTER_PULL):
+        r = explore.explore(_dp_scenario(cfg, 'pipeline'))
+        assert 'stale-prefetch' in r.kinds(), (cfg, r.kinds())
+
+
+def test_data_plane_sensitivity_guard():
+    """data_plane_model.analyze() must fail loudly if a seeded bug
+    stops re-deriving, exactly like the control-plane checker."""
+    from autodist_tpu.analysis import data_plane_model as dp
+    saved = dp.SEEDED_BUGS
+    try:
+        dp.SEEDED_BUGS = ((saved[0][0], saved[0][1], 'telemetry',
+                           'torn-read-clean'),)
+        findings = dp.analyze()
+        assert any('lost the sensitivity' in f for f in findings)
+    finally:
+        dp.SEEDED_BUGS = saved
+    # every exploration (5 HEAD scenarios + 6 seeds — two of which
+    # share scenario+kind) gets its own stats entry: a blowup in the
+    # second pipeline seed must not hide behind the first's count
+    dp.analyze()
+    assert len(dp.LAST_STATS['scenarios']) == 11, dp.LAST_STATS
+    assert dp.LAST_STATS['states_explored'] == sum(
+        dp.LAST_STATS['scenarios'].values())
+
+
+# -- epoch-swap model (ISSUE 13: the ROADMAP 2 contract) -------------------
+
+def _es_scenario(cfg, name):
+    from autodist_tpu.analysis import epoch_swap_model as es
+    return {s.name: s for s in es.scenarios(cfg)}[name]
+
+
+def test_epoch_swap_verified_ordering_explores_clean():
+    """The documented contract ordering (stage -> ack quorum with
+    nack-cancel -> boundary at prefix-min + staleness + 2 -> swap at
+    the boundary check, deaths degraded via exclusion) explores clean:
+    no step is ever executed under two plan generations, the cohort
+    never finishes split, and every branch (including a peer crash
+    anywhere) terminates."""
+    from autodist_tpu.analysis import epoch_swap_model as es, explore
+    for sc in es.scenarios(es.VERIFIED):
+        r = explore.explore(sc)
+        assert r.ok, '\n'.join(explore.format_violation(r, v)
+                               for v in r.violations)
+        assert r.terminals > 0
+    # and the swap actually HAPPENS on some branch (not vacuous): an
+    # early arm puts the boundary inside the run
+    sc = _es_scenario(es.VERIFIED, 'epoch_swap')
+    r = explore.explore(sc)
+    assert r.states > 1000
+
+
+def test_epoch_swap_before_ack_quorum_counterexamples():
+    """Arming the swap without the ack quorum swaps past a peer that
+    NACKed: the chief crosses the boundary onto plan N+1 while the
+    peer keeps executing plan N — the mixed-plan write the handshake
+    exists to prevent."""
+    from autodist_tpu.analysis import epoch_swap_model as es, explore
+    r = explore.explore(_es_scenario(es.SWAP_BEFORE_ACK_QUORUM,
+                                     'epoch_swap_nack'))
+    assert 'mixed-plan-step' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'mixed-plan-step'][0]
+    text = explore.format_violation(r, v)
+    print('\n' + text)
+    labels = [label for _, label in v.trace]
+    assert 'chief arms the swap (publishes boundary step)' in labels
+    assert 'BOTH plan' in v.diagnosis
+    # the verified ordering on the SAME scenario is clean (the nack
+    # cancels the swap instead)
+    r2 = explore.explore(_es_scenario(es.VERIFIED, 'epoch_swap_nack'))
+    assert r2.ok, r2.kinds()
+
+
+def test_epoch_swap_naive_boundary_counterexamples():
+    """Boundary = the chief's own next step assumes everyone is at
+    the chief's step; under the staleness window a peer already
+    executed that step under plan N."""
+    from autodist_tpu.analysis import epoch_swap_model as es, explore
+    r = explore.explore(_es_scenario(es.NAIVE_BOUNDARY, 'epoch_swap'))
+    assert 'mixed-plan-step' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'mixed-plan-step'][0]
+    print('\n' + explore.format_violation(r, v))
+    assert 'BOTH plan' in v.diagnosis
+
+
+def test_epoch_swap_sensitivity_guard():
+    from autodist_tpu.analysis import epoch_swap_model as es
+    saved = es.SEEDED_BUGS
+    try:
+        # a scenario where the wrong ordering cannot manifest
+        es.SEEDED_BUGS = ((saved[1][0], saved[1][1],
+                           'epoch_swap_nack', 'mixed-plan-step'),)
+        findings = es.analyze()
+        assert any('lost the sensitivity' in f for f in findings)
+    finally:
+        es.SEEDED_BUGS = saved
+
+
 # -- fence-coverage lint --------------------------------------------------
 
 _DOCTORED = '''\
@@ -167,6 +373,49 @@ def test_fence_lint_flags_missing_err_fenced_path():
     assert 'BSTEP' in findings
 
 
+def test_fence_lint_payload_bounds():
+    """The generalized PR 5 hardening (ISSUE 13): dropping a request-
+    size cap from payload_size(), dropping the in-block reply bound,
+    or adding an unclassified payload-bearing command are all
+    findings; HEAD is clean (covered by test_fence_lint_head_clean)."""
+    from autodist_tpu.analysis import fence_lint
+    text = open(fence_lint.SRC).read()
+    # every size-declaring command has a payload_size branch on HEAD
+    assert set(fence_lint.payload_size_branches(text)) >= {
+        'BSET', 'BADD', 'BSTEP', 'BSADD', 'BGETROWS'}
+    # drop the shared BSET/BADD/BSTEP request cap
+    d1 = text.replace(
+        'if (in.fail() || nbytes > kMaxPayload) return kBadPayload;',
+        'if (in.fail()) return kBadPayload;')
+    assert d1 != text
+    f1 = '\n'.join(fence_lint.check_payload_bounds(d1))
+    assert 'BSET' in f1 and 'kMaxPayload' in f1, f1
+    # drop the BGETROWS reply bound (the original PR 5 bug: a 256 GB
+    # nrows*ncols declaration allocated before any check)
+    d2 = text.replace(
+        'constexpr uint64_t kMaxElems = kMaxPayload / sizeof(float);',
+        'constexpr uint64_t kMaxElems = ~0ull;')
+    assert d2 != text
+    f2 = '\n'.join(fence_lint.check_payload_bounds(d2))
+    assert 'BGETROWS' in f2 and 'reply' in f2, f2
+    # a new dispatched command that touches the request payload
+    # without a PAYLOAD_BOUNDED entry forces a decision
+    d3 = text.replace(
+        'if (cmd == "BSTAT") {',
+        'if (cmd == "NEWBLOB") { if (payload.size()) {} return "OK"; '
+        '}\n  if (cmd == "BSTAT") {')
+    assert d3 != text
+    f3 = '\n'.join(fence_lint.check_payload_bounds(d3))
+    assert 'NEWBLOB' in f3 and 'PAYLOAD_BOUNDED' in f3, f3
+    # a comment mentioning the bound must NOT satisfy the lint
+    assert 'kMaxPayload' in fence_lint._strip_comments(
+        fence_lint.dispatched_blocks(text)['BGETROWS'])
+    # ...including a /* block comment */ (coord_service.cc uses them)
+    assert fence_lint._strip_comments(
+        'x; /* bounded by kMaxPayload upstream */ y;\n'
+        'z; // kMaxPayload here too\n') == 'x;  y;\nz; \n'
+
+
 # -- env-knob lint --------------------------------------------------------
 
 def test_env_lint_head_clean():
@@ -222,6 +471,98 @@ def test_env_lint_forwarding_classification():
     assert ENV.AUTODIST_PP_STASH_LIMIT_MB.val == 2048.0
     assert ENV.AUTODIST_FUSED_CONV_MAX_ROWS.val == 120000
     assert ENV.AUTODIST_FUSED_CONV.val is False
+
+
+def test_env_lint_docs_drift(tmp_path):
+    """The docs-drift invariant (ISSUE 13): an undocumented knob, a
+    choice the docs never name, and a choice the docs enumerate that
+    the validator rejects are all findings naming the knob and the
+    missing/stale side. HEAD is clean (test_env_lint_head_clean runs
+    the full analyze(), docs included)."""
+    from autodist_tpu.analysis import env_lint
+    # only the TOP-LEVEL docs/api is the generated mirror: a
+    # hand-written nested dir named 'api' still counts as docs
+    (tmp_path / 'api').mkdir()
+    (tmp_path / 'api' / 'gen.md').write_text('GENERATED_PAGE')
+    (tmp_path / 'usage' / 'api').mkdir(parents=True)
+    (tmp_path / 'usage' / 'api' / 'auth.md').write_text(
+        'AUTODIST_NESTED_KNOB explained here')
+    text = env_lint.docs_text(root=str(tmp_path))
+    assert 'AUTODIST_NESTED_KNOB' in text
+    assert 'GENERATED_PAGE' not in text
+    # const.py's real choice sets are parsed, not hand-copied
+    ch = env_lint.choice_sets()
+    assert ch['AUTODIST_PEER_FAILURE_POLICY'] == \
+        ('fail', 'exclude', 'restart')
+    assert ch['AUTODIST_STRAGGLER_POLICY'] == ('off', 'warn', 'advise')
+    # AST-parsed, so call formatting cannot silently drop a knob:
+    # double quotes, a renamed lambda parameter, odd whitespace
+    ch = env_lint.choice_sets(src=(
+        'X = (lambda raw: _choice("AUTODIST_NEW_KNOB",\n'
+        '                         raw, "a", ["a", "b"]),)\n'))
+    assert ch == {'AUTODIST_NEW_KNOB': ('a', 'b')}
+    # a non-literal choice set degrades to a FINDING, not a no-op
+    ch = env_lint.choice_sets(
+        src="Y = (lambda v: _choice('AUTODIST_DYN', v, 'a', ALL),)\n")
+    assert ch == {'AUTODIST_DYN': None}
+    f = env_lint.check_docs(declared=set(), choices=ch, docs='')
+    assert any('AUTODIST_DYN' in x and 'not a static literal' in x
+               for x in f), f
+    probe = {'AUTODIST_STRAGGLER_POLICY': ('off', 'warn', 'advise')}
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY', 'AUTODIST_GHOST_KNOB'},
+        choices=probe,
+        docs='AUTODIST_STRAGGLER_POLICY accepts off | warn here.')
+    text = '\n'.join(f)
+    assert 'AUTODIST_GHOST_KNOB' in text and 'missing side: docs' in \
+        text
+    assert "never name the choice 'advise'" in text
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='AUTODIST_STRAGGLER_POLICY is one of '
+             'off|warn|advise|verbose.')
+    assert any("'verbose'" in x and 'stale side: docs' in x for x in f)
+    # markdown table rows (the | cell delimiter) are not enumerations
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='| `AUTODIST_STRAGGLER_POLICY` | warn | one of off / '
+             'warn / advise |')
+    assert f == [], f
+    # ...even when the NEXT cell starts with a lowercase word (an enum
+    # run must not chain through the cell boundary and flag it)
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='| `AUTODIST_STRAGGLER_POLICY` | warn | off / warn / '
+             'advise | emits warnings |')
+    assert f == [], f
+    # escaped \| separators INSIDE a cell are still an enumeration
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='| `AUTODIST_STRAGGLER_POLICY` | one of `off` \\| '
+             '`warn` \\| `verbose` |')
+    assert any("'verbose'" in x for x in f), f
+    # a documented LONGER knob must not satisfy its undocumented
+    # prefix (the registry has real prefix pairs, e.g.
+    # AUTODIST_TELEMETRY / AUTODIST_TELEMETRY_DIR)
+    f = env_lint.check_docs(
+        declared={'AUTODIST_TELEMETRY'}, choices={},
+        docs='Set AUTODIST_TELEMETRY_DIR to choose the output dir.')
+    assert any('AUTODIST_TELEMETRY is registered' in x for x in f), f
+    # overlapping per-mention windows must not duplicate one stale
+    # token into N identical findings
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='AUTODIST_STRAGGLER_POLICY and AUTODIST_STRAGGLER_POLICY'
+             ': one of off|warn|advise|verbose.')
+    assert len([x for x in f if "'verbose'" in x]) == 1, f
+    # a NEIGHBORING knob's enumeration inside the ±700-char window —
+    # sharing 2+ choice tokens but on its own line — is not this
+    # knob's choice list; its extra members must not read as stale
+    f = env_lint.check_docs(
+        declared={'AUTODIST_STRAGGLER_POLICY'}, choices=probe,
+        docs='AUTODIST_STRAGGLER_POLICY: one of off|warn|advise.\n'
+             'AUTODIST_OTHER_POLICY: one of off|warn|error.')
+    assert f == [], f
 
 
 # -- schedule/plan consistency lint ---------------------------------------
@@ -283,8 +624,9 @@ def test_schedule_lint_reshard_preconditions():
 
 def test_analyze_cli_all_json():
     """`tools/analyze.py --all` exits 0 on HEAD with zero findings and
-    the --json report carries per-analyzer status (the shape bench/CI
-    records attach)."""
+    the --json report carries schema_version, per-analyzer wall time
+    and (for the model checkers) states-explored counts — the shape
+    bench.py stores under the stable 'analysis' BENCH key."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
          '--all', '--json'],
@@ -294,11 +636,17 @@ def test_analyze_cli_all_json():
     report = json.loads(r.stdout)
     assert report['clean'] is True
     assert report['findings'] == 0
-    assert set(report['analyzers']) == {'protocol', 'fence', 'env',
+    assert report['schema_version'] >= 2
+    assert set(report['analyzers']) == {'protocol', 'data-plane',
+                                        'epoch-swap', 'fence', 'env',
                                         'schedule'}
     for rec in report['analyzers'].values():
         assert rec['findings'] == []
         assert rec['elapsed_s'] >= 0
+    for checker in ('protocol', 'data-plane', 'epoch-swap'):
+        rec = report['analyzers'][checker]
+        assert rec['states_explored'] > 100, (checker, rec)
+        assert rec['scenarios'], (checker, rec)
 
 
 def test_analyze_cli_selective():
@@ -311,6 +659,19 @@ def test_analyze_cli_selective():
     assert r.returncode == 0, r.stdout + r.stderr
     assert 'fence' in r.stdout and 'env' in r.stdout
     assert 'schedule' not in r.stdout.split('analysis')[0]
+
+
+def test_analyze_cli_data_plane_epoch_swap():
+    """The new passes select individually and report their state
+    counts in the human-readable output."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         '--data-plane', '--epoch-swap'],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'data-plane' in r.stdout and 'epoch-swap' in r.stdout
+    assert 'states' in r.stdout
+    assert 'protocol' not in r.stdout.split('analysis')[0]
 
 
 # -- trace conformance (ISSUE 11: the dynamic twin) ------------------------

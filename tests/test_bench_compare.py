@@ -100,6 +100,40 @@ def test_failure_sentinel_is_a_regression_not_an_improvement():
     assert row['status'] == 'ok' and 'sentinel' in row['note']
 
 
+def test_analysis_metrics_gate_states_wider_walls():
+    """The analysis block gates: deterministic states counts regress at
+    the normal threshold, while the single-shot subprocess wall times
+    carry a 5x scale so machine noise (±30%) cannot fail the gate but
+    a genuine cost blowup (2x) still does."""
+    def rec(total_s=8.0, states=76000, dp_states=1507):
+        r = _record(wrapped=False)
+        r['extra']['analysis'] = {
+            'total_elapsed_s': total_s,
+            'states_explored_total': states,
+            'passes': {'protocol': {'elapsed_s': 6.5},
+                       'data-plane': {'states_explored': dp_states},
+                       'epoch-swap': {'states_explored': 22018}}}
+        return r
+    old = rec()
+    # +30% wall noise with identical state counts: clean
+    rep = compare(old, rec(total_s=10.4))
+    assert rep['clean'], rep
+    # a genuine 2x wall blowup: regression even at the 5x scale
+    rep = compare(old, rec(total_s=16.5))
+    rows = {r['metric']: r for r in rep['rows']}
+    assert rows['extra.analysis.total_elapsed_s']['status'] == \
+        'regression'
+    # state-space blowup in one pass regresses at the NORMAL threshold
+    rep = compare(old, rec(states=95000, dp_states=9000))
+    rows = {r['metric']: r for r in rep['rows']}
+    assert rows['extra.analysis.states_explored_total']['status'] == \
+        'regression'
+    assert rows[
+        'extra.analysis.passes.data-plane.states_explored'][
+        'status'] == 'regression'
+    assert not rep['clean']
+
+
 def test_compare_tolerates_missing_keys():
     old = _record(wrapped=False)
     del old['extra']['monitor']          # an older record

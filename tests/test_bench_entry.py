@@ -117,6 +117,24 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert mo['overhead_frac'] <= mo['overhead_budget_frac'], mo
         assert mo['dump']['slowdown_events'] >= 1, mo['dump']
         assert mo['dump']['conformance_clean'], mo['dump']
+    # ISSUE 13: every record carries the static-analysis trajectory
+    # block under its stable key — the whole analyzer suite ran clean
+    # with per-pass wall time and model-checker state counts, the
+    # numbers bench_compare gates analyzer-cost/state-space blowup on
+    an = extra['analysis']
+    assert 'error' not in an, an
+    assert an['clean'] is True and an['findings'] == 0, an
+    assert an['schema_version'] >= 2, an
+    assert an['total_elapsed_s'] > 0
+    for p in ('protocol', 'data-plane', 'epoch-swap', 'fence', 'env',
+              'schedule'):
+        assert p in an['passes'], an['passes']
+        assert an['passes'][p]['findings'] == 0, an['passes'][p]
+    for p in ('protocol', 'data-plane', 'epoch-swap'):
+        assert an['passes'][p]['states_explored'] > 100, an['passes'][p]
+    assert an['states_explored_total'] >= sum(
+        an['passes'][p]['states_explored']
+        for p in ('protocol', 'data-plane', 'epoch-swap'))
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

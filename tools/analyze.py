@@ -1,4 +1,4 @@
-"""Repo-wide static analysis CLI — one entry over the four analyzers.
+"""Repo-wide static analysis CLI — one entry over the six analyzers.
 
     python tools/analyze.py --all            # everything, exit 0 = clean
     python tools/analyze.py --fence --env    # just those analyzers
@@ -9,15 +9,26 @@
 Analyzers (autodist_tpu/analysis/, design notes in
 docs/design/static-analysis.md):
 
-  protocol   bounded model checking of the control-plane protocol
-             (HEAD orderings explore clean; the seeded historical bugs
-             must still re-derive as counterexamples)
-  fence      coord_service.cc dispatcher fence-coverage + header table
-             drift (absorbs tools/check_protocol.py)
-  env        AUTODIST_* env reads declared + worker knobs forwarded
-  schedule   sync_gradients vs static_collective_schedule emission
-             predicates, reshard shape algebra, wire-pricing drift
-             (absorbs tools/check_wire_pricing.py)
+  protocol    bounded model checking of the control-plane protocol
+              (HEAD orderings explore clean; the seeded historical
+              bugs must still re-derive as counterexamples)
+  data-plane  bounded model checking of the PS data plane: chunked
+              write sequences + torn-read parity, fence-recheck under
+              the tensor lock, the depth-2 pipeline's prefetch floor,
+              the telemetry batch cursor (seeded: PR 1 offset-0
+              abort, PR 5 disconnect wedge, PR 11 cursor race)
+  epoch-swap  the PROSPECTIVE strategy-distribution-epoch handshake
+              (ROADMAP 2): the verified stage->ack->arm->boundary
+              ordering explores clean, the tempting-but-wrong
+              orderings counterexample
+  fence       coord_service.cc dispatcher fence-coverage + payload
+              bounds + header table drift (absorbs
+              tools/check_protocol.py)
+  env         AUTODIST_* env reads declared + worker knobs forwarded
+              + docs mention every knob (choice sets in sync)
+  schedule    sync_gradients vs static_collective_schedule emission
+              predicates, reshard shape algebra, wire-pricing drift
+              (absorbs tools/check_wire_pricing.py)
 
 ``--conformance <dump>...`` is the dynamic twin (docs/design/
 observability.md): it replays the crash flight recorder's event trace
@@ -26,7 +37,12 @@ protocol (analysis/conformance.py), so chaos runs can assert the live
 system conforms.
 
 Fast, no devices, no processes: wired into tier-1 via
-tests/test_analysis.py. CI/bench records can attach the --json report.
+tests/test_analysis.py. CI/bench records attach the --json report
+(``bench.py`` stores it under the stable ``analysis`` BENCH key, and
+``tools/bench_compare.py`` flags analyzer-cost / state-space blowup
+regressions across records). The report carries ``schema_version``
+(bumped on shape changes), per-pass wall time, and — for the model
+checkers — states-explored counts.
 """
 import argparse
 import json
@@ -41,28 +57,47 @@ sys.path.insert(0, REPO)
 # keep the CLI runnable on devices-less hosts
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 
+#: Version of the --json report shape. Bump when a field is renamed,
+#: removed, or changes meaning — bench_compare keys off dotted paths
+#: into this report, and a silent shape change would read as metrics
+#: vanishing rather than as an incompatibility.
+SCHEMA_VERSION = 2
+
+ANALYZER_NAMES = ('protocol', 'data-plane', 'epoch-swap', 'fence',
+                  'env', 'schedule')
+
 
 def _analyzers():
-    from autodist_tpu.analysis import (env_lint, explore, fence_lint,
-                                       schedule_lint)
-    # cheap lints first; the model checker explores last
-    return (('fence', fence_lint.analyze),
-            ('env', env_lint.analyze),
-            ('schedule', schedule_lint.analyze),
-            ('protocol', explore.analyze))
+    from autodist_tpu.analysis import (data_plane_model, env_lint,
+                                       epoch_swap_model, explore,
+                                       fence_lint, schedule_lint)
+    # cheap lints first; the model checkers explore last
+    return (('fence', fence_lint, fence_lint.analyze),
+            ('env', env_lint, env_lint.analyze),
+            ('schedule', schedule_lint, schedule_lint.analyze),
+            ('protocol', explore, explore.analyze),
+            ('data-plane', data_plane_model, data_plane_model.analyze),
+            ('epoch-swap', epoch_swap_model, epoch_swap_model.analyze))
 
 
 def run(names=None):
     """Run the selected analyzers; returns the report dict."""
-    report = {'analyzers': {}, 'clean': True, 'findings': 0}
-    for name, fn in _analyzers():
+    report = {'schema_version': SCHEMA_VERSION, 'analyzers': {},
+              'clean': True, 'findings': 0}
+    for name, mod, fn in _analyzers():
         if names is not None and name not in names:
             continue
         t0 = time.monotonic()
         findings = fn()
-        report['analyzers'][name] = {
-            'findings': findings,
-            'elapsed_s': round(time.monotonic() - t0, 3)}
+        rec = {'findings': findings,
+               'elapsed_s': round(time.monotonic() - t0, 3)}
+        # model-checker passes publish their exploration size; the
+        # lints have none (getattr: LAST_STATS is a checker contract)
+        stats = getattr(mod, 'LAST_STATS', None)
+        if stats and 'states_explored' in stats:
+            rec['states_explored'] = stats['states_explored']
+            rec['scenarios'] = dict(stats['scenarios'])
+        report['analyzers'][name] = rec
         report['findings'] += len(findings)
         if findings:
             report['clean'] = False
@@ -77,10 +112,21 @@ def main(argv=None):
                     help='run every analyzer')
     ap.add_argument('--protocol', action='store_true',
                     help='control-plane protocol model checker')
+    ap.add_argument('--data-plane', action='store_true',
+                    dest='data_plane',
+                    help='PS data-plane model checker (chunk '
+                         'sequences, torn reads, pipeline floors, '
+                         'telemetry cursor)')
+    ap.add_argument('--epoch-swap', action='store_true',
+                    dest='epoch_swap',
+                    help='strategy-distribution-epoch handshake model '
+                         '(the ROADMAP 2 contract)')
     ap.add_argument('--fence', action='store_true',
-                    help='coord_service.cc fence-coverage lint')
+                    help='coord_service.cc fence-coverage + '
+                         'payload-bound lint')
     ap.add_argument('--env', action='store_true',
-                    help='AUTODIST_* env-knob lint')
+                    help='AUTODIST_* env-knob lint (declaration, '
+                         'forwarding, docs drift)')
     ap.add_argument('--schedule', action='store_true',
                     help='schedule/plan consistency lint')
     ap.add_argument('--json', action='store_true',
@@ -93,9 +139,10 @@ def main(argv=None):
     if args.conformance:
         from autodist_tpu.analysis import conformance
         findings = conformance.analyze(args.conformance)
-        report = {'analyzers': {'conformance': {
-            'findings': findings, 'elapsed_s': 0.0}},
-            'clean': not findings, 'findings': len(findings)}
+        report = {'schema_version': SCHEMA_VERSION,
+                  'analyzers': {'conformance': {
+                      'findings': findings, 'elapsed_s': 0.0}},
+                  'clean': not findings, 'findings': len(findings)}
         if args.json:
             print(json.dumps(report, indent=2, sort_keys=True))
         else:
@@ -105,8 +152,8 @@ def main(argv=None):
                   % ('CLEAN' if not findings else 'FAILED',
                      len(findings)))
         return 0 if not findings else 1
-    selected = {n for n in ('protocol', 'fence', 'env', 'schedule')
-                if getattr(args, n)}
+    selected = {n for n in ANALYZER_NAMES
+                if getattr(args, n.replace('-', '_'))}
     if args.all or not selected:
         selected = None
     report = run(selected)
@@ -116,7 +163,10 @@ def main(argv=None):
         for name, rec in report['analyzers'].items():
             status = 'clean' if not rec['findings'] else \
                 '%d finding(s)' % len(rec['findings'])
-            print('%-9s %s (%.2fs)' % (name, status, rec['elapsed_s']))
+            states = ', %d states' % rec['states_explored'] \
+                if 'states_explored' in rec else ''
+            print('%-11s %s (%.2fs%s)' % (name, status,
+                                          rec['elapsed_s'], states))
             for f in rec['findings']:
                 print('  - ' + f.replace('\n', '\n    '))
         print('analysis %s: %d finding(s)'

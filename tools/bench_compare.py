@@ -8,8 +8,9 @@ of the bench trajectory.
 Each BENCH_r*.json is either the driver wrapper (``{'parsed': {...}}``)
 or bench.py's raw output line. The comparison walks a curated metric
 table grouped by the stable record keys (grad_sync, quantized,
-hierarchical, elastic, ps_pipeline, telemetry, monitor, top-level
-throughput) with a per-metric direction; a NEW value worse than OLD by
+hierarchical, elastic, ps_pipeline, telemetry, monitor, analysis,
+top-level throughput) with a per-metric direction; a NEW value worse
+than OLD by
 more than ``--threshold`` (fractional, default 0.10) is a REGRESSION.
 Metrics missing from either record are reported as skipped, never
 fatal — older records predate newer keys.
@@ -57,6 +58,24 @@ METRICS = (
      'clean-leg false positives'),
     ('monitor', 'extra.monitor.overhead_frac', 'lower',
      'monitor poll overhead fraction'),
+    # the static-analysis trajectory: analyzer wall cost and model-
+    # checker state-space size are both tier-1 budget items — a pass
+    # that quietly doubles its exploration is a regression even at
+    # zero findings. The wall times are SINGLE-SHOT subprocess
+    # measurements (interpreter + import dominated), so they carry a
+    # 5x threshold scale: a real blowup roughly doubles them, machine
+    # noise does not move them 50%. The deterministic states counts
+    # gate at the normal threshold.
+    ('analysis', 'extra.analysis.total_elapsed_s', 'lower',
+     'static-analysis total wall time', 5),
+    ('analysis', 'extra.analysis.states_explored_total', 'lower',
+     'model-checker states explored (all passes)'),
+    ('analysis', 'extra.analysis.passes.protocol.elapsed_s', 'lower',
+     'protocol model-checker wall time', 5),
+    ('analysis', 'extra.analysis.passes.data-plane.states_explored',
+     'lower', 'data-plane model states explored'),
+    ('analysis', 'extra.analysis.passes.epoch-swap.states_explored',
+     'lower', 'epoch-swap model states explored'),
 )
 
 
@@ -90,7 +109,11 @@ def compare(old, new, threshold=0.10):
     """Walk the metric table; returns the report dict."""
     rows = []
     regressions = 0
-    for key, path, direction, label in METRICS:
+    for entry in METRICS:
+        key, path, direction, label = entry[:4]
+        # optional 5th element: per-metric threshold scale (noisy
+        # one-shot wall times gate wider than deterministic counts)
+        scale = entry[4] if len(entry) > 4 else 1
         a, b = _lookup(old, path), _lookup(new, path)
         row = {'key': key, 'metric': path, 'label': label,
                'direction': direction, 'old': a, 'new': b}
@@ -125,7 +148,8 @@ def compare(old, new, threshold=0.10):
             else:
                 worse = (a - b) / a if a else 0.0
             row['delta_frac'] = round(worse, 4)
-            row['status'] = 'regression' if worse > threshold else 'ok'
+            row['status'] = ('regression'
+                             if worse > threshold * scale else 'ok')
             if row['status'] == 'regression':
                 regressions += 1
         rows.append(row)
