@@ -13,11 +13,21 @@ PREDICATES at the AST level, so any asymmetric edit fails tier-1
 regardless of fixture coverage:
 
 - the bucket-fusion key (group, compressor, dtype, spec, hierarchical
-  knob) must have identical canonical components in both functions;
+  knob, weight-update-sharding knob) must have identical canonical
+  components in both functions;
 - the fusable-predicate (which compressors may bucket-fuse, the
   ``int8_bucket_fusable`` escape hatch) must admit the same set;
 - both sides must route the flat-vs-two-level choice through the ONE
   shared ``choose_hierarchical`` decision with the same signature;
+- both sides must route the replicated-vs-sharded weight-update
+  choice through the ONE shared ``choose_update_sharding`` decision
+  with the same signature (traced: ``_wus_for``), and the
+  update-shard emissions must exist on both sides: the traced
+  reduce-scatter + bucketed param all-gather
+  (``_wus_scatter_bucket`` / ``gather_updated_params``) and the
+  static ``psum_scatter``/``all_gather`` pair tagged ``wus`` — an
+  asymmetric edit (e.g. new emission traced but never priced) fails
+  tier-1 here, not just on the fixture pin;
 - both sides must pack with ``pack_buckets`` and emit in the same
   reverse-production order (the ``pending.sort`` key).
 
@@ -49,6 +59,7 @@ _CANON_RULES = (
     (r'str\(grad\.dtype\)', 'DTYPE'),
     (r'plan\.group', 'GROUP'),
     (r'plan\.spec', 'SPEC'),
+    (r'plan\.weight_update_sharding', 'WUS'),
     (r'plan\.hierarchical', 'HIER'),
 )
 
@@ -223,6 +234,64 @@ def check_emission_predicates(src=None):
             '%s vs static %s (same positional arity + kwargs required, '
             'or the two sides price different decisions)'
             % (traced_hier, static_hier))
+    # weight-update sharding: ONE shared decision + both emission
+    # halves present on both sides (the extension this lint grew for:
+    # an update-shard/all-gather emission edited on one side only must
+    # fail tier-1 regardless of fixture coverage)
+    wus_helper = fns.get('_wus_for')
+    traced_wus = _calls_of(wus_helper, src, 'choose_update_sharding') \
+        if wus_helper is not None else []
+    if not _calls_of(traced, src, '_wus_for'):
+        # the helper may still carry the shared call, but an emission
+        # that never CONSULTS it decides nothing
+        traced_wus = []
+    static_wus = _calls_of(static, src, 'choose_update_sharding')
+    if not traced_wus or not static_wus:
+        findings.append(
+            'plan.py: the replicated-vs-sharded weight-update decision '
+            'must route through the ONE shared '
+            'cost_model.choose_update_sharding on both sides (traced '
+            'call missing: %s, static call missing: %s)'
+            % (not traced_wus, not static_wus))
+    elif set(traced_wus) != set(static_wus):
+        findings.append(
+            'plan.py: choose_update_sharding call shapes DRIFTED — '
+            'traced %s vs static %s (same positional arity + kwargs '
+            'required, or the slot placement, traced emission and '
+            'priced schedule decide differently)'
+            % (traced_wus, static_wus))
+    scatter_fn = fns.get('_wus_scatter_bucket')
+    gather_fn = fns.get('gather_updated_params')
+    if scatter_fn is None or gather_fn is None:
+        findings.append(
+            'plan.py: weight-update-shard emission halves missing '
+            '(_wus_scatter_bucket: %s, gather_updated_params: %s) — '
+            'the schedule the simulator prices no longer exists'
+            % (scatter_fn is None, gather_fn is None))
+    else:
+        if not _calls_of(traced, src, '_wus_scatter_bucket'):
+            findings.append(
+                'plan.py: sync_gradients no longer dispatches '
+                'update-sharded buckets through _wus_scatter_bucket')
+        if not (_calls_of(gather_fn, src, 'all_gather') or
+                _calls_of(gather_fn, src, 'hierarchical_all_gather')):
+            findings.append(
+                'plan.py: gather_updated_params no longer emits the '
+                'bucketed param all-gather')
+    static_src = re.sub(r'\s+', '',
+                        ast.get_source_segment(src, static) or '')
+    for token, what in (
+            ("('psum_scatter','grad')",
+             'grad-phase reduce-scatter'),
+            ("('all_gather','param')",
+             'param-phase all-gather'),
+            ("'wus':True", 'wus tag')):
+        if token not in static_src:
+            findings.append(
+                'plan.py: static_collective_schedule no longer emits '
+                'the update-shard %s entry (%s) — the simulator would '
+                'price a schedule without the update-sharding halves'
+                % (what, token))
     for name, fn in (('sync_gradients', traced),
                      ('static_collective_schedule', static)):
         if not _calls_of(fn, src, 'pack_buckets'):

@@ -297,6 +297,22 @@ class ENV(Enum):
     # HLO across SPMD hosts deadlocks.
     AUTODIST_HIERARCHY_NODES = \
         (lambda v: _min_int('AUTODIST_HIERARCHY_NODES', v, 0, lo=0),)
+    # Cross-replica weight-update sharding override (parallel/plan.py,
+    # arXiv:2004.13336): '' (default) defers to each strategy's
+    # AllReduceSynchronizer.weight_update_sharding knob; 'auto',
+    # 'always' or 'never' overrides it globally — 'always' forces the
+    # reduce-scatter + shard-local fused update + bucketed param
+    # all-gather schedule wherever it is lowerable (uncompressed-wire
+    # AR buckets on an n>1 mesh), 'never' forces the legacy replicated
+    # update, 'auto' defers to the shared cost-model decision
+    # (simulator.cost_model.choose_update_sharding: freed opt-slot HBM
+    # vs exposed all-gather time). Forwarded to launched workers
+    # (coordinator _FORWARDED_FLAGS): the schedule AND the optimizer-
+    # slot layout are part of the traced program — divergent HLO
+    # across SPMD hosts deadlocks.
+    AUTODIST_WEIGHT_UPDATE_SHARDING = \
+        (lambda v: _choice('AUTODIST_WEIGHT_UPDATE_SHARDING', v, '',
+                           ('auto', 'always', 'never')),)
     # Execute chief re-plans (elastic scale-up re-ranks) instead of
     # only recording them: the session migrates its live state to the
     # re-ranked strategy through the device-side resharding path

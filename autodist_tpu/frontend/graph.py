@@ -446,6 +446,18 @@ def _eval_apply(node, env):
     for grad, var in node.grads_and_vars:
         gv.append((evaluate(grad, env), var))
     new_values = node.optimizer._apply(gv, env)
+    # weight-update-sharded variables come back as UpdateShards (each
+    # replica updated its 1/n); re-gather whole buckets at once — one
+    # collective per scatter bucket, the gather half of the schedule
+    # (parallel.plan.ExecutionPlan.gather_updated_params)
+    pending = {var: val for var, val in new_values.items()
+               if getattr(val, 'is_update_shard', False)}
+    if pending:
+        plan = next(iter(pending.values())).plan
+        gathered = plan.gather_updated_params(
+            {var.name: val for var, val in pending.items()})
+        for var in pending:
+            new_values[var] = gathered[var.name]
     for var, val in new_values.items():
         env.updates[var.name] = val
     return jnp.zeros((), jnp.int32)  # train-op sentinel value

@@ -87,6 +87,20 @@ class Optimizer:
         new_values = {}
         for grad, var in grads_and_vars:
             state = slots[var.name]
+            if getattr(grad, 'is_update_shard', False):
+                # cross-replica weight-update sharding: the grad is
+                # this replica's 1/n flat shard of the bucket
+                # reduce-scatter; slice the matching param shard (a
+                # local dynamic-slice — slots are already stored as
+                # flat shards), run the fused shard-local update, and
+                # hand the updated shard back — ApplyGradients
+                # evaluation re-gathers whole buckets afterwards.
+                value = grad.slice_param(env.var_values[var.name])
+                new_shard, slots[var.name] = self.shard_update(
+                    grad.value, state, value,
+                    axis_name=grad.axis_name)
+                new_values[var] = grad.with_value(new_shard)
+                continue
             if isinstance(grad, ShardedGrad):
                 value = env.var_shards[var.name]
                 update, new_state = self.tx.update(grad.value, state, value)
@@ -104,6 +118,27 @@ class Optimizer:
             slots[var.name] = new_state
         env.opt_updates[self.uid] = slots
         return new_values
+
+    def shard_update(self, grad, state, value, axis_name=None):
+        """Fused optimizer step over ONE weight-update shard: the 1/n
+        flat gradient shard, the matching shard-resident slot state
+        and param shard (cross-replica weight-update sharding,
+        parallel/plan.py).
+
+        The default applies the optimizer's own transform to the
+        shard, which is EXACT for elementwise updates — every built-in
+        optimizer here except LAMB (SGD/momentum, Adam(W), Adagrad,
+        RMSProp, Adadelta, Adamax, Nadam, Ftrl) updates each element
+        from that element's grad/slot/param alone, so sharding commutes
+        with the update bit-for-bit given the same reduced gradient.
+        Optimizers with cross-element coupling must override:
+        :class:`LAMB` computes its per-variable trust-ratio norms with
+        a ``psum`` over the shards. Custom non-elementwise transforms
+        that cannot be corrected this way should keep
+        ``weight_update_sharding='never'``.
+        """
+        update, new_state = self.tx.update(grad, state, value)
+        return value + update, new_state
 
     def _lazy_row_update(self, grad, state, value):
         """Row-masked update: rows with an all-zero gradient keep their
@@ -329,3 +364,47 @@ class LAMB(Optimizer):
                        weight_decay=weight_decay),
             name, _capture=('LAMB', (learning_rate,),
                             {'weight_decay': weight_decay}))
+        self._hp = {'learning_rate': learning_rate,
+                    'weight_decay': weight_decay, 'beta_1': beta_1,
+                    'beta_2': beta_2, 'epsilon': epsilon}
+
+    def shard_update(self, grad, state, value, axis_name=None):
+        """Fused shard-local LAMB step (weight-update sharding).
+
+        LAMB is the one built-in with cross-element coupling: its
+        trust ratio scales each variable's update by
+        ``||param|| / ||adam update||`` over the WHOLE variable, so a
+        naive per-shard application would use shard-local norms and
+        diverge from the replicated update. The fused step runs the
+        elementwise Adam half on the shard, then computes both norms
+        with a ``psum`` of the per-shard squared sums across the data
+        axis — the padded tail contributes exactly zero (zero param,
+        zero moments, zero grad), so the norms equal the full-tensor
+        norms up to summation re-association, and the sharded update
+        matches the replicated one within f32 re-association ulps.
+        """
+        chain = tuple(state) if isinstance(state, (tuple, list)) \
+            else (state,)
+        idx = next((i for i, s in enumerate(chain)
+                    if hasattr(s, 'mu') and hasattr(s, 'nu')), None)
+        if idx is None or axis_name is None:
+            return super().shard_update(grad, state, value,
+                                        axis_name=axis_name)
+        import jax
+        hp = self._hp
+        adam = optax.scale_by_adam(b1=hp['beta_1'], b2=hp['beta_2'],
+                                   eps=hp['epsilon'])
+        u, new_adam = adam.update(grad, chain[idx], value)
+        if hp['weight_decay']:
+            u = u + hp['weight_decay'] * value
+        p_norm = jnp.sqrt(jax.lax.psum(jnp.sum(value * value),
+                                       axis_name))
+        u_norm = jnp.sqrt(jax.lax.psum(jnp.sum(u * u), axis_name))
+        # optax scale_by_trust_ratio semantics: zero param or zero
+        # update -> ratio 1
+        ratio = jnp.where(p_norm == 0., 1.,
+                          jnp.where(u_norm == 0., 1., p_norm / u_norm))
+        new_state = chain[:idx] + (new_adam,) + chain[idx + 1:]
+        if not isinstance(state, (tuple, list)):
+            new_state = new_state[0]
+        return value - hp['learning_rate'] * ratio * u, new_state

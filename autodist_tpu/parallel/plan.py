@@ -112,6 +112,80 @@ def hierarchical_all_reduce(x, axis_name, node_groups):
     return out[:x.size].reshape(shape)
 
 
+def hierarchical_psum_scatter(x, axis_name, node_groups, axis=0):
+    """Two-level reduce-scatter (sum) along ``axis``: intra-node
+    reduce-scatter, then inter-node reduce-scatter of the owned chunk
+    over one representative per node — the scatter HALF of
+    :func:`hierarchical_all_reduce`, so the only cross-node traffic is
+    ``(k-1)/k`` of each node's ``1/g`` chunk. A chunk pre-permutation
+    makes the final ownership IDENTICAL to the flat ``psum_scatter``
+    (the device at data-axis position ``d`` owns chunk ``d``), so ZeRO
+    shard layouts and update-sharding buckets can swap schedules
+    without any relayout; the result is a pure re-association of the
+    flat sum (bit-identical whenever the per-element sums are exactly
+    representable). ``axis`` length must divide by the axis size.
+    Degenerate group shapes collapse to the flat collective.
+    """
+    k = len(node_groups) if node_groups else 0
+    g = len(node_groups[0]) if node_groups else 0
+    if k <= 1 or g <= 1:
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                    tiled=True)
+    n = k * g
+    moved = jnp.moveaxis(x, axis, 0)
+    m = moved.shape[0] // n
+    rest = moved.shape[1:]
+    # the two scatters deliver block (p, j) of a (g, k, m)-blocked
+    # layout to the device at intra position p in node j (= data-axis
+    # position j*g+p); pre-permuting (k, g) -> (g, j) block order makes
+    # that block the flat layout's chunk j*g+p
+    arranged = jnp.moveaxis(moved.reshape((k, g, m) + rest), 1, 0)
+    arranged = arranged.reshape((n * m,) + rest)
+    cur = jax.lax.psum_scatter(arranged, axis_name, scatter_dimension=0,
+                               tiled=True, axis_index_groups=node_groups)
+    inter = [[grp[r] for grp in node_groups] for r in range(g)]
+    cur = jax.lax.psum_scatter(cur, axis_name, scatter_dimension=0,
+                               tiled=True, axis_index_groups=inter)
+    return jnp.moveaxis(cur, 0, axis)
+
+
+def hierarchical_all_gather(x, axis_name, node_groups, axis=0):
+    """Two-level all-gather along ``axis``: inter-node all-gather of
+    this device's chunk (the DCN phase moves ``(k-1)/k`` of ``1/g`` of
+    the payload per device), then intra-node all-gather, then the
+    inverse of :func:`hierarchical_psum_scatter`'s chunk permutation —
+    the result is IDENTICAL to the flat tiled ``all_gather`` (chunk
+    ``d`` comes from data-axis position ``d``). The gather HALF of the
+    two-level schedule: ZeRO param re-gathers and the weight-update-
+    sharding bucket gather ride it when the shared cost-model decision
+    picks the hierarchical schedule.
+    """
+    k = len(node_groups) if node_groups else 0
+    g = len(node_groups[0]) if node_groups else 0
+    if k <= 1 or g <= 1:
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    moved = jnp.moveaxis(x, axis, 0)
+    m = moved.shape[0]
+    rest = moved.shape[1:]
+    inter = [[grp[r] for grp in node_groups] for r in range(g)]
+    cur = jax.lax.all_gather(moved, axis_name, axis=0, tiled=True,
+                             axis_index_groups=inter)
+    out = jax.lax.all_gather(cur, axis_name, axis=0, tiled=True,
+                             axis_index_groups=node_groups)
+    # out block (p, j) holds the shard of data-axis position j*g+p;
+    # permute back to flat chunk order
+    out = jnp.moveaxis(out.reshape((g, k, m) + rest), 1, 0)
+    out = out.reshape((k * g * m,) + rest)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _numel(shape):
+    n = 1
+    for d in (shape or (1,)):
+        n *= int(d)
+    return n
+
+
 def bucket_bytes_cap(chunk_size=0):
     """Per-bucket byte cap for fused gradient collectives.
 
@@ -189,9 +263,16 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
 
     Entries match the ``last_bucket_stats`` schema plus a ``phase``
     field: ``{'kind', 'group', 'compressor', 'dtype', 'spec', 'vars',
-    'bytes', 'members', 'phase', 'hier'}`` where ``phase`` is ``'grad'``
-    (gradient sync) or ``'param'`` (ZeRO param all-gather) and ``hier``
-    is the node-group count of a two-level all-reduce (0 = flat).
+    'bytes', 'members', 'phase', 'hier', 'wus'}`` where ``phase`` is
+    ``'grad'`` (gradient sync) or ``'param'`` (the post-update param
+    re-gather — ZeRO all-gather or the weight-update-sharding bucket
+    gather), ``hier`` is the node-group count of a two-level schedule
+    (0 = flat; ZeRO scatter/gather halves and update-sharding buckets
+    route through the same ``choose_hierarchical`` decision as AR
+    buckets) and ``wus`` marks the reduce-scatter + all-gather pair a
+    weight-update-sharded bucket lowers to
+    (``choose_update_sharding``, the shared decision — padded bytes,
+    sharded opt slots).
     ``bytes``
     are RAW tensor bytes; anything REPORTING traffic must route them
     through ``simulator.cost_model.wire_bytes`` (as the cost model,
@@ -208,9 +289,24 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     if n <= 1:
         return entries
     nodes = int(nodes or 1)
-    if nodes > 1 and params is None:
+    from autodist_tpu.simulator.cost_model import (
+        choose_hierarchical, choose_update_sharding,
+        optimizer_slot_count)
+    if params is None:
         from autodist_tpu.simulator.cost_model import CostModelParams
         params = CostModelParams()
+    opt_slots = optimizer_slot_count(graph_item)
+
+    def half_hier(nbytes, dtype, knob, spec):
+        """Two-level decision for ONE scatter/gather half — the same
+        shared choose_hierarchical call as the AR buckets (half time
+        is exactly half of AR time, so the comparison is identical)."""
+        if nodes <= 1:
+            return 0
+        return nodes if choose_hierarchical(
+            nbytes, dtype, 'NoneCompressor', n, nodes, params,
+            knob=knob, spec=spec) else 0
+
     node_cfg = {nd.var_name: nd for nd in strategy.node_config}
     sources = list(graph_item.trainable_var_op_to_var.values())
     plans = []
@@ -232,13 +328,13 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
         plans.append(plan)
 
     def entry(kind, plan, nbytes, members, phase='grad', vars_=1,
-              group=None, compressor=None):
+              group=None, compressor=None, hier=0):
         return {'kind': kind, 'group': group, 'compressor': compressor,
                 'dtype': str(np.dtype(plan.var.dtype)), 'spec': plan.spec,
                 'vars': vars_, 'bytes': int(nbytes), 'members': members,
-                'phase': phase, 'hier': 0}
+                'phase': phase, 'hier': hier, 'wus': False}
 
-    fusable = {}   # (group, compressor name, dtype, spec, hier) -> [idx]
+    fusable = {}   # (group, compressor, dtype, spec, hier, wus) -> [idx]
     for i, (var, plan) in enumerate(zip(sources, plans)):
         itemsize = np.dtype(var.dtype).itemsize
         size = int(np.prod(var.shape or (1,)))
@@ -260,11 +356,15 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                                      [var.name]))
             else:
                 # mirror _capped_psum_scatter's chunking exactly
+                # (incl. its per-chunk two-level decision)
                 cap = bucket_bytes_cap(plan.chunk_size)
                 ndim = len(var.shape)
+                dstr = str(np.dtype(var.dtype))
                 if padded <= cap or ndim < 2:
-                    entries.append(entry('psum_scatter', plan, padded,
-                                         [var.name]))
+                    entries.append(entry(
+                        'psum_scatter', plan, padded, [var.name],
+                        hier=half_hier(padded, dstr,
+                                       plan.hierarchical, plan.spec)))
                 else:
                     split_axis = 0 if plan.shard_axis != 0 else 1
                     dim = int(padded_shape[split_axis])
@@ -272,8 +372,12 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                     k = min(dim, -(-padded // cap))
                     for j in range(k):
                         rows = dim * (j + 1) // k - dim * j // k
-                        entries.append(entry('psum_scatter', plan,
-                                             rows * row, [var.name]))
+                        entries.append(entry(
+                            'psum_scatter', plan, rows * row,
+                            [var.name],
+                            hier=half_hier(rows * row, dstr,
+                                           plan.hierarchical,
+                                           plan.spec)))
             # the updated shard is re-gathered for the next step. A
             # sparse (embedding) table only needs its looked-up rows
             # fresh — the loose-mode row-sparse plane refreshes them
@@ -288,8 +392,11 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                                      sparse_bytes, [var.name],
                                      phase='param'))
             else:
-                entries.append(entry('all_gather', plan, padded,
-                                     [var.name], phase='param'))
+                entries.append(entry(
+                    'all_gather', plan, padded, [var.name],
+                    phase='param',
+                    hier=half_hier(padded, str(np.dtype(var.dtype)),
+                                   plan.hierarchical, plan.spec)))
         elif sparse and type(plan.compressor) is comp.NoneCompressor \
                 and sparse_bytes < nbytes:
             entries.append(entry('sparse_all_gather', plan, sparse_bytes,
@@ -300,7 +407,8 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                  comp.int8_bucket_fusable(plan.compressor, var.dtype,
                                           size)):
             key = (plan.group, cname, str(np.dtype(var.dtype)),
-                   plan.spec, plan.hierarchical)
+                   plan.spec, plan.hierarchical,
+                   plan.weight_update_sharding)
             fusable.setdefault(key, []).append(i)
         else:
             entries.append(entry('all_reduce', plan, nbytes, [var.name],
@@ -308,7 +416,8 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     # pack fusable groups exactly like sync_gradients: byte-capped
     # buckets in reverse production order, emitted tail-first
     pending = []
-    for (group, cname, dtype, spec, hknob), idxs in fusable.items():
+    for (group, cname, dtype, spec, hknob, wknob), idxs in \
+            fusable.items():
         chunk = max(plans[i].chunk_size for i in idxs)
         cap = bucket_bytes_cap(chunk)
         items = [(i, int(np.prod(sources[i].shape or (1,))) *
@@ -318,23 +427,48 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
         for bucket in pack_buckets(items, cap,
                                    chunk or DEFAULT_CHUNK_SIZE):
             pending.append((bucket, sizes, group, cname, dtype, spec,
-                            hknob))
+                            hknob, wknob))
     pending.sort(key=lambda b: -max(b[0]))
-    for bucket, sizes, group, cname, dtype, spec, hknob in pending:
+    for bucket, sizes, group, cname, dtype, spec, hknob, wknob in \
+            pending:
         nbytes = sum(sizes[i] for i in bucket)
-        hier = 0
-        if nodes > 1:
-            from autodist_tpu.simulator.cost_model import \
-                choose_hierarchical
-            if choose_hierarchical(nbytes, dtype, cname, n, nodes,
-                                   params, knob=hknob, spec=spec):
+        if choose_update_sharding(nbytes, dtype, cname, n, params,
+                                  knob=wknob, opt_slots=opt_slots,
+                                  cross_node=nodes > 1, spec=spec):
+            # weight-update-sharded bucket: reduce-scatter (grad
+            # phase) + bucketed param all-gather (param phase), each
+            # member zero-padded to a multiple of n — exactly what
+            # _wus_scatter_bucket / gather_updated_params emit. The
+            # psum_scatter kind is what makes memory_footprint drop
+            # the members' opt-slot (and resident-grad) bytes to 1/n.
+            itemsize = np.dtype(dtype).itemsize
+            wbytes = sum((-(-(sizes[i] // itemsize) // n)) * n * itemsize
+                         for i in bucket)
+            hier = 0
+            if nodes > 1 and choose_hierarchical(
+                    wbytes, dtype, cname, n, nodes, params,
+                    knob=hknob, spec=spec):
                 hier = nodes
+            members = [sources[i].name for i in bucket]
+            for kind, phase in (('psum_scatter', 'grad'),
+                                ('all_gather', 'param')):
+                entries.append({
+                    'kind': kind, 'group': group, 'compressor': cname,
+                    'dtype': dtype, 'spec': spec, 'vars': len(bucket),
+                    'bytes': wbytes, 'members': list(members),
+                    'phase': phase, 'hier': hier, 'wus': True})
+            continue
+        hier = 0
+        if nodes > 1 and choose_hierarchical(
+                nbytes, dtype, cname, n, nodes, params,
+                knob=hknob, spec=spec):
+            hier = nodes
         entries.append({
             'kind': 'all_reduce', 'group': group, 'compressor': cname,
             'dtype': dtype, 'spec': spec, 'vars': len(bucket),
             'bytes': nbytes,
             'members': [sources[i].name for i in bucket],
-            'phase': 'grad', 'hier': hier})
+            'phase': 'grad', 'hier': hier, 'wus': False})
     return entries
 
 
@@ -348,21 +482,95 @@ class ShardedGrad:
     ``logical_dim`` records the unpadded size of the shard axis for
     uneven partitions (UnevenPartitionedPS): physical shards are padded
     to equal size, and :meth:`gather` slices the padding back off.
+
+    ``hier_groups`` carries the node groups of a two-level param
+    re-gather (the gather half of the hierarchical ZeRO schedule) when
+    the shared cost-model decision picked it
+    (:meth:`ExecutionPlan.gather_hier_groups`); None = flat.
     """
 
-    def __init__(self, value, axis, logical_dim=None):
+    def __init__(self, value, axis, logical_dim=None, hier_groups=None):
         self.value = value
         self.axis = axis
         self.logical_dim = logical_dim
+        self.hier_groups = hier_groups
 
     def gather(self):
-        full = jax.lax.all_gather(self.value, AXIS_DATA, axis=self.axis,
-                                  tiled=True)
+        if self.hier_groups:
+            full = hierarchical_all_gather(self.value, AXIS_DATA,
+                                           self.hier_groups,
+                                           axis=self.axis)
+        else:
+            full = jax.lax.all_gather(self.value, AXIS_DATA,
+                                      axis=self.axis, tiled=True)
         if self.logical_dim is not None and \
                 full.shape[self.axis] != self.logical_dim:
             full = jax.lax.slice_in_dim(full, 0, self.logical_dim,
                                         axis=self.axis)
         return full
+
+
+class UpdateShard:
+    """One variable's 1/n flat shard inside a weight-update-sharded
+    bucket (cross-replica weight-update sharding, arXiv:2004.13336).
+
+    Produced by :meth:`ExecutionPlan.sync_gradients` carrying the
+    MEAN-gradient shard of an update-sharded AR bucket member;
+    consumed by ``Optimizer._apply``, which slices the matching param
+    shard (:meth:`slice_param`), runs the fused shard-local update
+    against shard-resident slots (``Optimizer.shard_update``) and
+    hands back an UpdateShard of the UPDATED param via
+    :meth:`with_value`; the frontend's ApplyGradients evaluation then
+    re-gathers whole buckets at once through
+    :meth:`ExecutionPlan.gather_updated_params`.
+
+    The flat layout is row-major over the variable, zero-padded to a
+    multiple of n; the device at data-axis position d owns elements
+    ``[d*m, (d+1)*m)`` — the same ownership the flat and hierarchical
+    reduce-scatters deliver. ``meta`` is the bucket record shared by
+    every member (names, shard sizes, hier groups), which is how the
+    gather side reassembles the exact scatter buckets.
+    """
+
+    is_update_shard = True
+    axis_name = AXIS_DATA
+
+    def __init__(self, value, plan, var, meta, index):
+        self.value = value
+        self.plan = plan
+        self.var = var
+        self.meta = meta
+        self.index = index
+
+    @property
+    def shard_size(self):
+        return self.meta['shard_sizes'][self.index]
+
+    def slice_param(self, full_value):
+        """This replica's flat param shard of the (replicated) full
+        value — a local dynamic-slice, no communication."""
+        m = self.shard_size
+        flat = jnp.ravel(full_value)
+        padded = m * self.plan.num_replicas
+        if padded > flat.shape[0]:
+            flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+        start = jax.lax.axis_index(AXIS_DATA) * m
+        return jax.lax.dynamic_slice(flat, (start,), (m,))
+
+    def with_value(self, new_value):
+        return UpdateShard(new_value, self.plan, self.var, self.meta,
+                           self.index)
+
+    def gather(self):
+        """Full var-shaped value from the shards (single-member gather
+        — used by direct fetches / user arithmetic via ``_degrade``;
+        the ApplyGradients fast path gathers whole buckets instead)."""
+        if self.meta.get('hier_groups'):
+            full = hierarchical_all_gather(self.value, AXIS_DATA,
+                                           self.meta['hier_groups'])
+        else:
+            full = jax.lax.all_gather(self.value, AXIS_DATA, tiled=True)
+        return full[:_numel(self.var.shape)].reshape(self.var.shape)
 
 
 class VarPlan:
@@ -391,12 +599,37 @@ class VarPlan:
             self.chunk_size = getattr(self.sync, 'chunk_size', 0)
             self.hierarchical = getattr(self.sync, 'hierarchical',
                                         'auto') or 'auto'
+            self.weight_update_sharding = getattr(
+                self.sync, 'weight_update_sharding', 'never') or 'never'
+            if getattr(var, 'sparse_read', False):
+                # row-lazy semantics (LazyAdam/LazyMomentum keep
+                # zero-grad rows bit-identical) are defined over whole
+                # rows; the flat 1/n shard layout cannot compute the
+                # row mask shard-locally, so sparse-read variables keep
+                # the replicated update — 'ineligible' is stronger than
+                # 'never': the env override does not shard it either
+                self.weight_update_sharding = 'ineligible'
         else:
             self.compressor = comp.create('NoneCompressor', var.name)
             self.group = None
             self.spec = 'AUTO'
             self.chunk_size = 0
-            self.hierarchical = 'never'
+            # the ZeRO scatter/gather halves route through the same
+            # choose_hierarchical decision as the AR buckets; the
+            # PSSynchronizer's knob governs it ('auto' default)
+            self.hierarchical = getattr(self.sync, 'hierarchical',
+                                        'auto') or 'auto'
+            self.weight_update_sharding = 'never'
+        # Cross-replica weight-update sharding (set by ExecutionPlan
+        # from the per-bucket choose_update_sharding decision): the
+        # gradient bucket is reduce-scattered, the optimizer updates
+        # this replica's 1/n flat shard against shard-resident slots,
+        # and the updated params ride a bucketed all-gather. The flat
+        # layout is row-major, zero-padded to wus_padded = n * wus_shard.
+        self.update_sharded = False
+        self.wus_shard = 0       # per-replica flat shard elements
+        self.wus_padded = 0      # padded flat size (n * wus_shard)
+        self.wus_pad = 0         # zero-pad elements at the flat tail
         # ZeRO-style state sharding applies to partitioned vars; when the
         # partition axis does not divide the mesh data axis (the uneven
         # case, UnevenPartitionedPS) the physical state is zero-padded to
@@ -459,6 +692,35 @@ class ExecutionPlan:
                     plan.padded_dim = -(-dim // n) * n
                     plan.pad = plan.padded_dim - dim
             self.var_plans[name] = plan
+        # Weight-update-sharding marking: the per-BUCKET decision
+        # (cost_model.choose_update_sharding over the exact packed
+        # buckets) is precomputed here because the optimizer-slot
+        # PLACEMENT must be known before any trace — the session
+        # places each marked variable's slots as flat 1/n shards.
+        # static_collective_schedule runs the SAME packing and the
+        # SAME shared decision the traced emission re-derives
+        # (_wus_for), so marking, trace and pricing can never drift.
+        env_wus = ENV.AUTODIST_WEIGHT_UPDATE_SHARDING.val
+        may_shard = env_wus in ('auto', 'always') or (
+            env_wus != 'never' and any(
+                p.is_ar and p.weight_update_sharding != 'never'
+                for p in self.var_plans.values()))
+        if may_shard and self.num_replicas > 1:
+            nodes_n = len(self.hier_groups) if self.hier_groups else 1
+            for e in static_collective_schedule(
+                    strategy, graph_item, self.num_replicas,
+                    nodes=nodes_n, params=self.cost_params):
+                if not (e.get('wus') and e['kind'] == 'psum_scatter'):
+                    continue
+                for name in e['members']:
+                    p = self.var_plans.get(name)
+                    if p is None:
+                        continue
+                    size = _numel(p.var.shape)
+                    p.update_sharded = True
+                    p.wus_shard = -(-size // self.num_replicas)
+                    p.wus_padded = p.wus_shard * self.num_replicas
+                    p.wus_pad = p.wus_padded - size
         self.max_staleness = max(
             [p.staleness for p in self.var_plans.values()] + [0])
         self._pure_sparse_cache = {}
@@ -519,6 +781,37 @@ class ExecutionPlan:
                                  self.num_replicas, len(groups),
                                  self.cost_params, knob=knob, spec=spec)
         return groups if ok else None
+
+    def _wus_for(self, nbytes, dtype, compressor_name, spec, knob):
+        """Replicated-vs-sharded weight-update decision for ONE bucket
+        — the trace-time side of the SHARED cost-model decision
+        (``cost_model.choose_update_sharding``), the same call the
+        init-time slot-placement marking and
+        ``static_collective_schedule`` make, so the traced emission,
+        the slot layout and the priced schedule can never drift."""
+        from autodist_tpu.simulator.cost_model import (
+            choose_update_sharding, optimizer_slot_count)
+        return choose_update_sharding(
+            nbytes, dtype, compressor_name, self.num_replicas,
+            self.cost_params, knob=knob,
+            opt_slots=optimizer_slot_count(self.graph_item),
+            cross_node=bool(self.hier_groups), spec=spec)
+
+    def gather_hier_groups(self, plan):
+        """Node groups for a ZeRO-sharded variable's param re-gather
+        (``ShardedGrad.gather``), or None for flat — the gather half
+        routes through the same shared ``choose_hierarchical``
+        decision as its reduce-scatter half (half-vs-half compares
+        exactly like AR-vs-AR; ``cost_model.hierarchical_half_time``)."""
+        if not plan.state_sharded:
+            return None
+        import numpy as np
+        shape = self.padded_shape(plan.var.name) or plan.var.shape
+        nbytes = _numel(shape) * np.dtype(plan.var.dtype).itemsize
+        return self._hier_groups_for(nbytes,
+                                     str(np.dtype(plan.var.dtype)),
+                                     'NoneCompressor', plan.spec,
+                                     plan.hierarchical)
 
     # -- sparse (IndexedSlices-equivalent) gradient sync ------------------
     def _purely_sparse(self, var):
@@ -637,33 +930,37 @@ class ExecutionPlan:
         cap = bucket_bytes_cap(plan.chunk_size)
         nbytes = g.size * jnp.dtype(g.dtype).itemsize
 
-        def scatter(x):
+        def scatter(x, nb):
+            # each chunk's scatter independently takes the two-level
+            # schedule when the shared cost-model decision prices it
+            # cheaper (the hierarchical treatment of the ZeRO scatter
+            # half; the gather half decides in gather_hier_groups)
+            groups = self._hier_groups_for(int(nb), str(x.dtype),
+                                           'NoneCompressor', plan.spec,
+                                           plan.hierarchical)
+            self.last_bucket_stats.append({
+                'kind': 'psum_scatter', 'group': None,
+                'compressor': None, 'dtype': str(x.dtype),
+                'spec': plan.spec, 'vars': 1, 'bytes': int(nb),
+                'members': [plan.var.name],
+                'hier': len(groups) if groups else 0})
+            _emit_bucket_tag(self.last_bucket_stats[-1])
+            if groups:
+                return hierarchical_psum_scatter(
+                    x, AXIS_DATA, groups, axis=axis) / n
             return jax.lax.psum_scatter(
                 x, AXIS_DATA, scatter_dimension=axis, tiled=True) / n
 
         if nbytes <= cap or g.ndim < 2:
-            self.last_bucket_stats.append({
-                'kind': 'psum_scatter', 'group': None,
-                'compressor': None, 'dtype': str(g.dtype),
-                'spec': plan.spec, 'vars': 1, 'bytes': int(nbytes),
-                'members': [plan.var.name]})
-            _emit_bucket_tag(self.last_bucket_stats[-1])
-            return scatter(g)
+            return scatter(g, nbytes)
         split_axis = 0 if axis != 0 else 1
         dim = g.shape[split_axis]
         k = min(dim, -(-int(nbytes) // cap))
         bounds = [dim * i // k for i in range(1, k)]
         parts = jnp.split(g, bounds, axis=split_axis)
-        for p in parts:
-            self.last_bucket_stats.append({
-                'kind': 'psum_scatter', 'group': None,
-                'compressor': None, 'dtype': str(g.dtype),
-                'spec': plan.spec, 'vars': 1,
-                'bytes': int(p.size * jnp.dtype(p.dtype).itemsize),
-                'members': [plan.var.name]})
-            _emit_bucket_tag(self.last_bucket_stats[-1])
-        return jnp.concatenate([scatter(p) for p in parts],
-                               axis=split_axis)
+        return jnp.concatenate(
+            [scatter(p, p.size * jnp.dtype(p.dtype).itemsize)
+             for p in parts], axis=split_axis)
 
     def sync_gradients(self, sources, grads, env):
         """Average gradients across the data axis per each var's strategy.
@@ -705,7 +1002,8 @@ class ExecutionPlan:
                 out[i] = ShardedGrad(
                     self._capped_psum_scatter(plan, grad),
                     plan.shard_axis,
-                    logical_dim=grad.shape[plan.shard_axis])
+                    logical_dim=grad.shape[plan.shard_axis],
+                    hier_groups=self.gather_hier_groups(plan))
             elif (ids is not None and
                     type(plan.compressor) is comp.NoneCompressor and
                     sparse_bytes < grad.size):
@@ -717,7 +1015,8 @@ class ExecutionPlan:
                      comp.int8_bucket_fusable(plan.compressor,
                                               grad.dtype, grad.size))):
                 key = (plan.group, type(plan.compressor).__name__,
-                       str(grad.dtype), plan.spec, plan.hierarchical)
+                       str(grad.dtype), plan.spec, plan.hierarchical,
+                       plan.weight_update_sharding)
                 fusable.setdefault(key, []).append(i)
             else:
                 out[i] = plan.compressor.reduce(
@@ -729,8 +1028,10 @@ class ExecutionPlan:
         # mesh the shared cost-model decision can send a large
         # DCN-bound bucket down the hierarchical schedule while small
         # buckets keep the flat ring.
-        pending = []   # (bucket idxs, group, cname, dtype, spec, hknob)
-        for (group, cname, dtype, spec, hknob), idxs in fusable.items():
+        pending = []   # (bucket idxs, group, cname, dtype, spec,
+        #                 hknob, wknob)
+        for (group, cname, dtype, spec, hknob, wknob), idxs in \
+                fusable.items():
             chunk = max(self.plan_for(sources[i]).chunk_size
                         for i in idxs)
             cap = bucket_bytes_cap(chunk)
@@ -740,12 +1041,24 @@ class ExecutionPlan:
             for bucket in pack_buckets(items, cap,
                                        chunk or DEFAULT_CHUNK_SIZE):
                 pending.append((bucket, group, cname, dtype, spec,
-                                hknob))
+                                hknob, wknob))
         pending.sort(key=lambda b: -max(b[0]))
-        for bucket, group, cname, dtype, spec, hknob in pending:
+        for bucket, group, cname, dtype, spec, hknob, wknob in pending:
             nbytes = sum(int(grads[i].size *
                              jnp.dtype(grads[i].dtype).itemsize)
                          for i in bucket)
+            if self._wus_for(nbytes, dtype, cname, spec, wknob):
+                # cross-replica weight-update sharding: the bucket is
+                # reduce-SCATTERED instead of all-reduced — each
+                # replica receives its contiguous 1/n of every member,
+                # updates it shard-locally (Optimizer.shard_update
+                # against shard-resident slots) and the updated params
+                # ride one bucketed all-gather (gather_updated_params)
+                for i, sh in self._wus_scatter_bucket(
+                        bucket, sources, grads, group, cname, dtype,
+                        spec, hknob):
+                    out[i] = sh
+                continue
             groups = self._hier_groups_for(nbytes, dtype, cname, spec,
                                            hknob)
             self.last_bucket_stats.append({
@@ -831,6 +1144,118 @@ class ExecutionPlan:
             return comp.int8_hierarchical_all_reduce(
                 transmitted, AXIS_DATA, hier_groups) / n
         return comp.int8_ring_all_reduce(transmitted, AXIS_DATA) / n
+
+    def _wus_scatter_bucket(self, bucket, sources, grads, group, cname,
+                            dtype, spec, hknob):
+        """Scatter half of ONE weight-update-sharded bucket.
+
+        Pads each member's flat gradient to a multiple of n, interleaves
+        the members' per-replica rows so a SINGLE reduce-scatter hands
+        every replica the contiguous concat of its member shards (no
+        second relayout collective), and wraps each member's
+        mean-gradient shard in an :class:`UpdateShard`. The scatter
+        independently takes the two-level schedule under the same
+        shared ``choose_hierarchical`` decision as an equal-bytes AR
+        bucket (half-vs-half prices exactly like AR-vs-AR). Returns
+        ``[(source index, UpdateShard)]``.
+        """
+        n = self.num_replicas
+        rows, shard_sizes = [], []
+        for i in bucket:
+            f = grads[i].reshape(-1)
+            padded = -(-f.shape[0] // n) * n
+            if padded > f.shape[0]:
+                f = jnp.pad(f, (0, padded - f.shape[0]))
+            rows.append(f.reshape(n, -1))
+            shard_sizes.append(padded // n)
+        buf = jnp.concatenate(rows, axis=1).reshape(-1)
+        padded_bytes = int(buf.size * jnp.dtype(buf.dtype).itemsize)
+        groups = self._hier_groups_for(padded_bytes, dtype, cname, spec,
+                                       hknob)
+        if groups:
+            shard = hierarchical_psum_scatter(buf, AXIS_DATA,
+                                              groups) / n
+        else:
+            shard = jax.lax.psum_scatter(buf, AXIS_DATA,
+                                         scatter_dimension=0,
+                                         tiled=True) / n
+        meta = {'members': [sources[i].name for i in bucket],
+                'shard_sizes': shard_sizes,
+                'hier_groups': groups,
+                'group': group, 'compressor': cname, 'dtype': dtype,
+                'spec': spec, 'bytes': padded_bytes}
+        self.last_bucket_stats.append({
+            'kind': 'psum_scatter', 'group': group,
+            'compressor': cname, 'dtype': dtype, 'spec': spec,
+            'vars': len(bucket), 'bytes': padded_bytes,
+            'members': list(meta['members']),
+            'hier': len(groups) if groups else 0, 'wus': True})
+        _emit_bucket_tag(self.last_bucket_stats[-1])
+        out, off = [], 0
+        for pos, (i, m) in enumerate(zip(bucket, shard_sizes)):
+            out.append((i, UpdateShard(shard[off:off + m], self,
+                                       sources[i], meta, pos)))
+            off += m
+        return out
+
+    def gather_updated_params(self, shards):
+        """Gather half of the weight-update-sharding schedule: one
+        bucketed all-gather per scatter bucket, reassembling every
+        member's full updated value from the shard-local optimizer
+        results.
+
+        ``shards`` maps var name -> :class:`UpdateShard` carrying the
+        UPDATED param shard (``Optimizer._apply``'s output); called by
+        the frontend's ApplyGradients evaluation. Buckets mirror the
+        scatter buckets exactly (the shared ``meta`` record), which is
+        what ``static_collective_schedule``'s param-phase
+        ``all_gather`` entries price; a PARTIALLY applied bucket (the
+        user updated only some members — rare) degrades to per-member
+        gathers. Returns ``{var name: full var-shaped value}``.
+        """
+        out = {}
+        buckets = {}
+        for name, sh in shards.items():
+            buckets.setdefault(id(sh.meta), (sh.meta, {}))[1][name] = sh
+        for meta, members in buckets.values():
+            names = meta['members']
+            if set(names) != set(members):
+                for name, sh in members.items():
+                    out[name] = sh.gather()
+                    self.last_bucket_stats.append({
+                        'kind': 'all_gather', 'group': meta['group'],
+                        'compressor': meta['compressor'],
+                        'dtype': meta['dtype'], 'spec': meta['spec'],
+                        'vars': 1,
+                        'bytes': sh.shard_size * self.num_replicas *
+                        jnp.dtype(sh.value.dtype).itemsize,
+                        'members': [name],
+                        'hier': len(meta['hier_groups'])
+                        if meta['hier_groups'] else 0, 'wus': True})
+                    _emit_bucket_tag(self.last_bucket_stats[-1])
+                continue
+            cat = jnp.concatenate([members[nm].value for nm in names])
+            groups = meta['hier_groups']
+            if groups:
+                full = hierarchical_all_gather(cat, AXIS_DATA, groups)
+            else:
+                full = jax.lax.all_gather(cat, AXIS_DATA, tiled=True)
+            self.last_bucket_stats.append({
+                'kind': 'all_gather', 'group': meta['group'],
+                'compressor': meta['compressor'],
+                'dtype': meta['dtype'], 'spec': meta['spec'],
+                'vars': len(names), 'bytes': meta['bytes'],
+                'members': list(names),
+                'hier': len(groups) if groups else 0, 'wus': True})
+            _emit_bucket_tag(self.last_bucket_stats[-1])
+            mat = full.reshape(self.num_replicas, -1)
+            off = 0
+            for nm, m in zip(names, meta['shard_sizes']):
+                var = members[nm].var
+                flat = mat[:, off:off + m].reshape(-1)
+                out[nm] = flat[:_numel(var.shape)].reshape(var.shape)
+                off += m
+        return out
 
     # -- padded physical layout (uneven partitions) ------------------------
     def padded_shape(self, var_name):
@@ -946,6 +1371,9 @@ class ExecutionPlan:
             if p.is_ar:
                 extra += ' group=%s compressor=%s' % (
                     p.group, type(p.compressor).__name__)
+            if p.update_sharded:
+                extra += ' [update-sharded%s]' % (
+                    ' pad=%d' % p.wus_pad if p.wus_pad else '')
             if p.staleness:
                 extra += ' staleness=%d' % p.staleness
             lines.append('  %s: %s%s' % (name, kind, extra))

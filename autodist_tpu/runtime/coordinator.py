@@ -47,6 +47,10 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # hierarchical node-group layout is part of the
                     # traced program (two-level collective schedules)
                     ENV.AUTODIST_HIERARCHY_NODES,
+                    # weight-update-sharding override: the schedule and
+                    # the optimizer-slot layout are part of the traced
+                    # program — every SPMD host must agree
+                    ENV.AUTODIST_WEIGHT_UPDATE_SHARDING,
                     # bucket layout + overlap flags must agree on every
                     # traced host — divergent HLO across SPMD deadlocks
                     ENV.AUTODIST_BUCKET_BYTES, ENV.AUTODIST_XLA_OVERLAP,

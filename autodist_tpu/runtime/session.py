@@ -1074,6 +1074,29 @@ class Session:
                                     reason='shard_geometry')
                 self._flight.dump('replan_refusal')
                 return
+            # weight-update-sharded slots live as FLAT 1/n shards; a
+            # plan change that flips any variable's update-sharding
+            # would need a slot-layout conversion the reshard pass
+            # (which moves var-SHAPED leaves) does not perform — refuse
+            # rather than silently carry a mislaid slot layout
+            wus_moved = [
+                name for name in variables
+                if getattr(old_plan.var_plans.get(name),
+                           'update_sharded', False) !=
+                getattr(new_plan.var_plans.get(name),
+                        'update_sharded', False)]
+            if wus_moved:
+                entry['migration_skipped'] = (
+                    'weight-update-sharding layout changes for %s — '
+                    'flat slot shards need their own conversion pass'
+                    % sorted(wus_moved)[:4])
+                logging.warning(
+                    'executed re-plan for world=%d refused: %s', world,
+                    entry['migration_skipped'])
+                self._flight.record('replan_refused', world=world,
+                                    reason='weight_update_sharding')
+                self._flight.dump('replan_refusal')
+                return
             # device-side layout moves: vars + matching optimizer slots
             ops = reshard_mod.plan_reshard(old_plan, new_plan)
             fns = {op.var_name:
@@ -1808,14 +1831,27 @@ class Session:
     def _place_slots(self, var_name, leafstate):
         """Shard optimizer slots like their variable (ZeRO, padded like
         the variable for uneven partitions); scalars (e.g. step counts)
-        replicate."""
+        replicate. Weight-update-sharded variables store their slots as
+        FLAT 1/n shards over the data axis (row-major, zero-padded to
+        ``wus_padded``) — the layout the fused shard-local update
+        consumes, and the ~(n-1)/n opt-slot HBM saving the sharded
+        update exists for."""
         var = self._graph_item.var_by_name(var_name)
+        vplan = self._plan.var_plans.get(var_name)
         sharding = self._plan.var_sharding(var_name)
         repl = self._plan.replicated_sharding()
+        wus = vplan is not None and getattr(vplan, 'update_sharded',
+                                            False)
 
         def place(leaf):
             if hasattr(leaf, 'shape') and tuple(leaf.shape) == \
                     tuple(var.shape):
+                if wus:
+                    flat = jnp.ravel(jnp.asarray(leaf))
+                    if vplan.wus_pad:
+                        flat = jnp.pad(flat, (0, vplan.wus_pad))
+                    return self._put(
+                        flat, NamedSharding(self._mesh, P(AXIS_DATA)))
                 return self._put(
                     self._plan.pad_host(var_name, jnp.asarray(leaf)),
                     sharding)
@@ -1824,6 +1860,12 @@ class Session:
         return jax.tree.map(place, leafstate)
 
     def _slot_spec(self, var_name, leaf):
+        vplan = self._plan.var_plans.get(var_name)
+        if vplan is not None and getattr(vplan, 'update_sharded',
+                                         False) and \
+                hasattr(leaf, 'shape') and \
+                tuple(leaf.shape) == (vplan.wus_padded,):
+            return P(AXIS_DATA)   # flat weight-update shard layout
         # placed slots carry the variable's physical (padded) shape
         phys = self._plan.padded_shape(var_name)
         if phys is None:
@@ -2745,7 +2787,8 @@ class Session:
                 p = plan.var_plans[name]
                 full[name] = ShardedGrad(
                     var_state[name], p.shard_axis,
-                    logical_dim=p.var.shape[p.shard_axis]).gather()
+                    logical_dim=p.var.shape[p.shard_axis],
+                    hier_groups=plan.gather_hier_groups(p)).gather()
             # strip the per-replica leading dim for in-step aux access
             aux_local = jax.tree.map(lambda x: x[0], aux_state)
             env = fe.Env(full, dict(zip(feed_nodes, feeds)),

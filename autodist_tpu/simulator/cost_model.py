@@ -118,6 +118,21 @@ class CostModelParams:
     # is as fast as ICI (single fat switch), where the two extra
     # phases buy nothing.
     hier_boundary_s_per_byte: float = 2.5e-12
+    # What one byte of freed per-device HBM is worth in step-time
+    # seconds — the exchange rate choose_update_sharding prices the
+    # weight-update-sharding trade with (arXiv:2112.01075's point:
+    # price the extra all-gather against the freed memory instead of
+    # hard-coding the choice). Sharding the update frees
+    # ~(n-1)/n of the opt-slot bytes but exposes the param all-gather
+    # (it cannot hide behind backward compute the way grad buckets
+    # do). The default is calibrated so an ICI-rich mesh (where wire
+    # time is cheap and HBM is the binding resource — the paper's TPU
+    # pod setting) shards, while a DCN-bound link (where the exposed
+    # gather is expensive) keeps the replicated update. Freed HBM
+    # also feeds back mechanically: the memory estimate drops sharded
+    # slots to 1/n, so AutoStrategy's budget pruning unlocks sharded
+    # candidates (and thus bigger batches) on tight budgets.
+    freed_hbm_s_per_byte: float = 4e-12
     calibrated: bool = False
 
     @classmethod
@@ -204,6 +219,126 @@ def hierarchical_time(nbytes, n, nodes, params, ici_bytes=None):
             2.0 * (k - 1) / k * (nbytes / g) * b_d
         t += ici * params.hier_boundary_s_per_byte
     return t
+
+
+def hierarchical_half_time(nbytes, n, nodes, params, ici_bytes=None):
+    """Predicted seconds for ONE two-level HALF (a reduce-scatter or an
+    all-gather) over ``n`` devices in ``nodes`` node groups.
+
+    :func:`hierarchical_time` is phase-symmetric (each tier's
+    reduce-scatter and all-gather phases move the same bytes, and the
+    boundary HBM pass splits evenly between the two halves), so a half
+    is exactly half of the full two-level all-reduce — which keeps
+    RS + AG == AR, the same identity the flat formulas satisfy, and
+    means :func:`choose_hierarchical` is THE decision for halves too:
+    flat-half beats hier-half exactly when flat AR beats hier AR.
+    Used for the hierarchical ZeRO scatter/gather halves and the
+    weight-update-sharding schedule's bucket halves.
+    """
+    return 0.5 * hierarchical_time(nbytes, n, nodes, params,
+                                   ici_bytes=ici_bytes)
+
+
+#: f32 optimizer-slot tensors per parameter by captured optimizer name
+#: (autodist_tpu.frontend.optimizers capture tuples). Used to size the
+#: freed-memory credit choose_update_sharding prices; unknown names
+#: fall back to the Adam-shaped default (2) — over-estimating the
+#: credit merely shards a low-state optimizer's update early, which
+#: costs one exposed all-gather, never correctness.
+_SLOTS_BY_OPTIMIZER = {
+    'SGD': 1, 'GradientDescent': 1, 'Momentum': 1, 'LazyMomentum': 1,
+    'Adagrad': 1, 'RMSProp': 2, 'Adadelta': 2,
+    'Adam': 2, 'AdamW': 2, 'LazyAdam': 2, 'Nadam': 2, 'Adamax': 2,
+    'LAMB': 2, 'Ftrl': 2,
+}
+
+
+def optimizer_slot_count(graph_item, default=2):
+    """f32 slot tensors per param for the graph's captured optimizers
+    (the max across them — one shared placement serves every var).
+
+    Reads the frontend graph's optimizer capture when present
+    (``graph_item.graph.optimizers``); pytree graph items (no captured
+    optimizer) and unknown names use ``default``. A plain SGD capture
+    with momentum 0 counts 0 (optax.sgd keeps no slot state then).
+    """
+    g = getattr(graph_item, 'graph', None)
+    caps = list(getattr(g, 'optimizers', None) or ()) if g is not None \
+        else []
+    if not caps:
+        return default
+    out = 0
+    for cap in caps:
+        name, _, kwargs = (tuple(cap) + ((), {}))[:3]
+        slots = _SLOTS_BY_OPTIMIZER.get(name, default)
+        if name in ('SGD', 'GradientDescent') and \
+                not (kwargs or {}).get('momentum'):
+            slots = 0
+        out = max(out, slots)
+    return out
+
+
+def choose_update_sharding(nbytes, dtype, compressor, n, params,
+                           knob='never', opt_slots=2, cross_node=False,
+                           spec='AUTO'):
+    """THE per-bucket replicated-vs-sharded weight-update decision,
+    shared by ``plan.sync_gradients`` (trace-time emission and slot
+    placement) and ``plan.static_collective_schedule`` (what predict()
+    prices) so the predicted and traced schedules can never drift.
+
+    Returns True when the bucket's post-sync optimizer update should
+    shard across replicas (reduce-scatter + shard-local fused update +
+    bucketed param all-gather, arXiv:2004.13336) instead of running
+    replicated after a plain all-reduce. Replicated stays the emission
+    (False) on single-replica meshes, compressed wires (the RS/AG
+    halves would need the compressor's reduction semantics on both
+    phases — only the uncompressed f32/native wire shards), forced
+    RING specs (an explicit flat-ring request — RS/AG would drop the
+    forced ppermute emission), ``knob='ineligible'`` (sparse-read /
+    row-lazy variables: the flat 1/n shard layout cannot preserve
+    row-lazy update semantics, so VarPlan marks them ineligible and
+    not even the env override shards them), and ``knob='never'`` (the
+    legacy default). 'always' forces it; 'auto' shards when the freed
+    opt-slot HBM (``opt_slots`` f32 slots x (n-1)/n of the params),
+    valued at ``params.freed_hbm_s_per_byte``, outweighs the newly
+    *exposed* wire time — the param all-gather runs after the update
+    and cannot hide behind backward compute, so the exposure is the
+    overlap haircut the replaced all-reduce would have enjoyed (the
+    reduce-scatter half stays in the backward and keeps it, which is
+    how predict() prices every non-LAST grad bucket). The last-emitted
+    grad bucket gets no haircut in either schedule, so for it the true
+    exposure delta is zero and this per-bucket decision (which cannot
+    know emission position — the same call marks slot placement before
+    any trace) overstates the cost: a deliberate conservatism that
+    only errs toward the legacy replicated update, and only matters
+    for models whose gradients pack into a single bucket ('always'
+    overrides it).
+
+    The ``AUTODIST_WEIGHT_UPDATE_SHARDING`` env knob overrides the
+    strategy knob globally (it is forwarded to workers: the schedule
+    is part of the traced program, and divergent HLO across SPMD
+    hosts deadlocks).
+    """
+    from autodist_tpu.const import ENV
+    if (knob or 'never') == 'ineligible':
+        return False
+    forced = ENV.AUTODIST_WEIGHT_UPDATE_SHARDING.val
+    knob = forced or knob or 'never'
+    n = int(n)
+    if n <= 1 or (compressor or 'NoneCompressor') != 'NoneCompressor':
+        return False
+    if spec == 'RING' or knob == 'never':
+        return False
+    if knob == 'always':
+        return True
+    wb = wire_bytes(nbytes, dtype, compressor)
+    alpha, beta = params.link(cross_node=cross_node)
+    exposed_extra = params.overlap_discount * 0.5 * collective_time(
+        'all_reduce', wb, n, alpha, beta)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    elems = int(nbytes) // itemsize
+    freed = opt_slots * _OPT_SLOT_ITEMSIZE * elems * (n - 1) / n
+    return freed * params.freed_hbm_s_per_byte >= exposed_extra
 
 
 def choose_hierarchical(nbytes, dtype, compressor, n, nodes, params,
@@ -315,10 +450,14 @@ def memory_footprint(strategy, graph_item, num_replicas,
     Components: params + grads (param dtype), optimizer slots (f32,
     ``optimizer_slots`` per param — 2 for Adam's mu/nu, 1 for momentum
     SGD, 0 for plain SGD), and bucket staging (the largest grad bucket's
-    concat input + reduced output live simultaneously). ZeRO-sharded
-    (partitioned PS) variables count 1/n of their padded size for state
-    components; every replica still materializes the FULL gathered param
-    for compute, which params counts at full size.
+    concat input + reduced output live simultaneously). Opt-slot bytes
+    are LAYOUT-aware: any variable whose schedule reduce-scatters its
+    gradient to a shard owner — ZeRO-sharded (partitioned PS) variables
+    AND weight-update-sharded AR buckets — keeps only 1/n of its slot
+    (and resident-grad) bytes per device, so budget pruning stops
+    rejecting sharded-update configs that actually fit. Every replica
+    still materializes the FULL gathered param for compute, which
+    params counts at full size.
     """
     n = max(1, int(num_replicas))
     if schedule is None:
@@ -337,9 +476,13 @@ def memory_footprint(strategy, graph_item, num_replicas,
         params_b += nbytes
         grads_b += int(nbytes * frac)
         opt_b += int(size * _OPT_SLOT_ITEMSIZE * optimizer_slots * frac)
+    # staging: a multi-var bucket's concat input + collective output
+    # coexist — for the all-reduce buckets AND the update-sharding
+    # reduce-scatter buckets (same concat, scattered output)
     max_bucket = max(
         [e['bytes'] for e in schedule
-         if e['kind'] == 'all_reduce' and e['vars'] > 1] or [0])
+         if e['kind'] in ('all_reduce', 'psum_scatter')
+         and e['vars'] > 1] or [0])
     staging_b = 2 * max_bucket
     total = params_b + grads_b + opt_b + staging_b
     return {'params_bytes': params_b, 'grads_bytes': grads_b,
@@ -391,8 +534,15 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         nodes=nodes, params=params)
     breakdown = []
     sync = 0.0
+    # grad-phase buckets that ride the backward: all-reduce buckets
+    # AND the update-sharding reduce-scatter halves (the RS replaces
+    # an AR bucket in the same backward position, so it keeps the same
+    # overlap haircut — the exposure choose_update_sharding assumes:
+    # only the param all-gather is newly exposed)
     grad_ar = [i for i, e in enumerate(schedule)
-               if e['kind'] == 'all_reduce' and e['phase'] == 'grad']
+               if e['phase'] == 'grad' and
+               (e['kind'] == 'all_reduce' or
+                (e.get('wus') and e['kind'] == 'psum_scatter'))]
     last_grad_ar = grad_ar[-1] if grad_ar else -1
     exposed = 0.0
     for i, e in enumerate(schedule):
@@ -405,6 +555,11 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
             ici_b = e['bytes'] \
                 if e.get('compressor') == 'Int8RingCompressor' else wb
             t = hierarchical_time(wb, n, hier, params, ici_bytes=ici_b)
+        elif hier > 1 and e['kind'] in ('psum_scatter', 'all_gather'):
+            # a two-level ZeRO / update-sharding HALF: exactly half of
+            # the two-level all-reduce (phase symmetry), so the same
+            # choose_hierarchical decision applies
+            t = hierarchical_half_time(wb, n, hier, params)
         else:
             t = collective_time(e['kind'], wb, n, alpha, beta)
         if wb < e['bytes']:   # compressor cast: two HBM passes per end
@@ -423,7 +578,13 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         overlappable = (i in grad_ar and i != last_grad_ar)
         if overlappable:
             t_exposed = t * (1.0 - params.overlap_discount)
-        elif e['phase'] == 'param' and params.ps_overlap_discount:
+        elif e['phase'] == 'param' and params.ps_overlap_discount \
+                and not e.get('wus'):
+            # the weight-update-sharding param all-gather is an
+            # in-step SPMD collective after the optimizer update — the
+            # async-PS pipeline cannot hide it, so it is priced fully
+            # exposed (exactly the exposure choose_update_sharding
+            # weighs against the freed memory)
             t_exposed = t * (1.0 - params.ps_overlap_discount)
         else:
             t_exposed = t
@@ -432,7 +593,7 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         breakdown.append({
             'kind': e['kind'], 'phase': e['phase'], 'vars': e['vars'],
             'bytes': e['bytes'], 'wire_bytes': wb,
-            'hier': hier,
+            'hier': hier, 'wus': bool(e.get('wus')),
             'time_s': t, 'exposed_time_s': t_exposed,
             'members': e['members'][:4] + (
                 ['... %d more' % (len(e['members']) - 4)]

@@ -66,6 +66,16 @@ def default_candidates(chunk_sizes=(32, 128, 512)):
          lambda: b.AllReduce(hierarchical='always')),
         ('AllReduce(flat-only)',
          lambda: b.AllReduce(hierarchical='never')),
+        # cross-replica weight-update sharding (arXiv:2004.13336):
+        # grad reduce-scatter + shard-local fused update + bucketed
+        # param all-gather — same total wire as the all-reduce it
+        # replaces, but the param gather is exposed (it cannot hide
+        # behind backward) while opt slots drop to 1/n per device, so
+        # the memory estimate lets budget pruning flip the rank on
+        # HBM-tight configs (the default AllReduce candidates are its
+        # replicated-update control)
+        ('AllReduce(update-shard)',
+         lambda: b.AllReduce(weight_update_sharding='always')),
         ('PartitionedAR', lambda: b.PartitionedAR()),
         ('RandomAxisPartitionAR',
          lambda: b.RandomAxisPartitionAR(seed=0)),

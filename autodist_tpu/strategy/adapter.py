@@ -196,9 +196,11 @@ def grad_bucket_layout(strategy, graph_item):
 
     # mirror sync_gradients' fusable filter and grouping key exactly:
     # only stateless compressors fuse (stateful ones reduce per-var),
-    # the key includes the gradient dtype (mixed-dtype groups split)
-    # and the hierarchical knob (mixed flat/two-level members split)
-    groups = {}   # (group, compressor, spec, dtype, hier) -> items
+    # the key includes the gradient dtype (mixed-dtype groups split),
+    # the hierarchical knob (mixed flat/two-level members split) and
+    # the weight-update-sharding knob (mixed replicated/sharded-update
+    # members split — their emissions differ in kind, not just shape)
+    groups = {}   # (group, compressor, spec, dtype, hier, wus) -> items
     for node in strategy.node_config:
         sync = node.synchronizer if not node.part_config \
             else node.part_config[0]
@@ -213,10 +215,14 @@ def grad_bucket_layout(strategy, graph_item):
             continue
         nbytes = int(np.prod(var.shape or (1,))) * \
             np.dtype(var.dtype).itemsize
+        wus = getattr(sync, 'weight_update_sharding', 'never') or \
+            'never'
+        if getattr(var, 'sparse_read', False):
+            wus = 'ineligible'   # mirror VarPlan's row-lazy exclusion
         groups.setdefault(
             (sync.group, sync.compressor, sync.spec,
              str(np.dtype(var.dtype)),
-             getattr(sync, 'hierarchical', 'auto') or 'auto'),
+             getattr(sync, 'hierarchical', 'auto') or 'auto', wus),
             []).append(
             (node.var_name, nbytes, getattr(sync, 'chunk_size', 0)))
     out = []

@@ -34,11 +34,21 @@ class PSSynchronizer:
     and updated parameters are all-gathered — push/pull without a literal
     server. ``sync=False`` / ``staleness>0`` engage the bounded-staleness
     pipeline (delayed gradient application windows).
+
+    ``hierarchical`` governs the two-level lowering of the ZeRO halves
+    (the gradient reduce-scatter and the param all-gather) on
+    multi-node meshes, routed through the same
+    ``cost_model.choose_hierarchical`` decision the AR buckets use:
+    'auto' (default — the cost model decides per emission), 'never'
+    (always the flat collective) or 'always'. Legacy strategies
+    deserialize to 'auto'; single-node meshes are the degenerate flat
+    case either way.
     """
     reduction_destination: str = ''
     local_replication: bool = False
     sync: bool = True
     staleness: int = 0
+    hierarchical: str = 'auto'    # auto | never | always
     # loose mode: run the optimizer step ON the PS with service-resident
     # slot state shared by all workers (the reference re-creates the
     # optimizer over PS-resident variables, kernel/partitioner.py:570-573,
@@ -71,12 +81,27 @@ class AllReduceSynchronizer:
     decides per bucket; flat is the degenerate single-node case),
     'never' (always the flat ring) or 'always' (force two-level where
     node groups exist). Legacy strategies deserialize to 'auto'.
+    ``weight_update_sharding`` governs cross-replica sharding of the
+    optimizer update itself (arXiv:2004.13336): instead of every
+    replica running the full update over replicated slots, the fused
+    gradient bucket is reduce-SCATTERED, each replica updates its 1/n
+    shard with shard-resident optimizer slots, and the updated params
+    ride one bucketed all-gather — freeing ~(n-1)/n of the opt-slot
+    HBM at the cost of an exposed param-phase all-gather. 'never'
+    (default — the legacy replicated update), 'always', or 'auto'
+    (the shared ``cost_model.choose_update_sharding`` decision prices
+    the all-gather exposure against the freed memory). Only
+    NoneCompressor (uncompressed-wire), non-RING buckets shard, and
+    sparse-read (row-lazy) variables never do — the flat shard layout
+    cannot preserve LazyAdam/LazyMomentum row semantics; the
+    ``AUTODIST_WEIGHT_UPDATE_SHARDING`` env knob overrides globally.
     """
     spec: str = 'AUTO'            # AUTO | RING
     compressor: str = 'NoneCompressor'
     group: int = 0
     chunk_size: int = 0
     hierarchical: str = 'auto'    # auto | never | always
+    weight_update_sharding: str = 'never'   # never | auto | always
     kind: str = 'AllReduce'
 
 

@@ -178,13 +178,15 @@ class AllReduce(StrategyBuilder):
     """All dense variables via grouped collective all-reduce."""
 
     def __init__(self, chunk_size=128, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor', hierarchical='auto'):
+                 compressor='NoneCompressor', hierarchical='auto',
+                 weight_update_sharding='never'):
         if chunk_size < 1:
             raise ValueError('The chunk_size must be greater than zero.')
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
         self.hierarchical = hierarchical
+        self.weight_update_sharding = weight_update_sharding
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -198,7 +200,8 @@ class AllReduce(StrategyBuilder):
                     compressor=self.compressor,
                     group=i // self.chunk_size,
                     chunk_size=self.chunk_size,
-                    hierarchical=self.hierarchical)))
+                    hierarchical=self.hierarchical,
+                    weight_update_sharding=self.weight_update_sharding)))
         return s
 
 
@@ -206,11 +209,13 @@ class PartitionedAR(StrategyBuilder):
     """Axis-0 partitioning, each shard synced by all-reduce."""
 
     def __init__(self, chunk_size=128, all_reduce_spec='AUTO',
-                 compressor='NoneCompressor', hierarchical='auto'):
+                 compressor='NoneCompressor', hierarchical='auto',
+                 weight_update_sharding='never'):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
         self.hierarchical = hierarchical
+        self.weight_update_sharding = weight_update_sharding
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -235,7 +240,8 @@ class PartitionedAR(StrategyBuilder):
                 spec=self.all_reduce_spec, compressor=self.compressor,
                 group=(counter + i) // self.chunk_size,
                 chunk_size=self.chunk_size,
-                hierarchical=self.hierarchical)
+                hierarchical=self.hierarchical,
+                weight_update_sharding=self.weight_update_sharding)
 
         if num_shards <= 1:
             return StrategyNode(var_name=var.name,
@@ -374,11 +380,12 @@ class Parallax(StrategyBuilder):
     def __init__(self, chunk_size=128, local_proxy_variable=False,
                  sync=True, staleness=0, all_reduce_spec='AUTO',
                  compressor='NoneCompressor', shared_optimizer=False,
-                 hierarchical='auto'):
+                 hierarchical='auto', weight_update_sharding='never'):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
         self.hierarchical = hierarchical
+        self.weight_update_sharding = weight_update_sharding
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
@@ -408,6 +415,8 @@ class Parallax(StrategyBuilder):
                         compressor=self.compressor,
                         group=dense_count // self.chunk_size,
                         chunk_size=self.chunk_size,
-                        hierarchical=self.hierarchical)))
+                        hierarchical=self.hierarchical,
+                        weight_update_sharding=self.
+                        weight_update_sharding)))
                 dense_count += 1
         return s

@@ -581,9 +581,11 @@ def test_schedule_lint_flags_emission_drift():
     drifted = src.replace(
         "key = (plan.group, type(plan.compressor).__name__,\n"
         "                       str(grad.dtype), plan.spec, "
-        "plan.hierarchical)",
+        "plan.hierarchical,\n"
+        "                       plan.weight_update_sharding)",
         "key = (plan.group, type(plan.compressor).__name__,\n"
-        "                       str(grad.dtype), plan.spec)")
+        "                       str(grad.dtype), plan.spec,\n"
+        "                       plan.weight_update_sharding)")
     assert drifted != src
     findings = schedule_lint.check_emission_predicates(drifted)
     assert any('fusion keys DRIFTED' in f for f in findings)
@@ -599,6 +601,38 @@ def test_schedule_lint_flags_emission_drift():
     assert drifted2 != src
     findings = schedule_lint.check_emission_predicates(drifted2)
     assert any('fusable predicates DRIFTED' in f for f in findings)
+
+
+def test_schedule_lint_flags_update_sharding_drift():
+    """The weight-update-sharding cross-check (ISSUE 14 extension
+    contract): an emission edited on one side only — static losing the
+    wus psum_scatter/all_gather pair, or the traced side losing its
+    choose_update_sharding routing — must be a finding, not just a
+    fixture-pin gamble."""
+    from autodist_tpu.analysis import schedule_lint
+    src = open(schedule_lint.PLAN_SRC).read()
+    # static side loses the wus tag on its emitted pair
+    drifted = src.replace(
+        "'phase': phase, 'hier': hier, 'wus': True})",
+        "'phase': phase, 'hier': hier})")
+    assert drifted != src
+    findings = schedule_lint.check_emission_predicates(drifted)
+    assert any('wus tag' in f for f in findings)
+    # traced side stops routing through the shared decision
+    drifted2 = src.replace(
+        'if self._wus_for(nbytes, dtype, cname, spec, wknob):',
+        'if False:')
+    assert drifted2 != src
+    findings = schedule_lint.check_emission_predicates(drifted2)
+    assert any('choose_update_sharding' in f for f in findings)
+    # static side stops emitting the param-phase all_gather half
+    drifted3 = src.replace(
+        "for kind, phase in (('psum_scatter', 'grad'),\n"
+        "                                ('all_gather', 'param')):",
+        "for kind, phase in (('psum_scatter', 'grad'),):")
+    assert drifted3 != src
+    findings = schedule_lint.check_emission_predicates(drifted3)
+    assert any('param-phase all-gather' in f for f in findings)
 
 
 def test_schedule_lint_reshard_preconditions():

@@ -82,6 +82,26 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert h['flat']['hier_buckets'] == 0, h
     assert h['dcn_bytes_reduction'] >= 3.0, h
     assert h['state_max_abs_diff'] < 1e-5, h
+    # ISSUE 14: every record carries the weight-update-sharding A/B
+    # under its stable key — the sharded schedule really emitted
+    # (scatter+gather pair, every var update-sharded), it frees
+    # >= 2x of the per-device opt-slot bytes at n >= 4 replicas with
+    # state (vars AND slots) inside f32 re-association tolerance, and
+    # the simulator's prediction for the sharded candidate rides the
+    # record next to the measurement
+    wu = extra['weight_update']
+    assert 'error' not in wu, wu
+    assert wu['devices'] >= 4, wu
+    assert wu['sharded']['update_sharded_vars'] >= 1, wu
+    assert wu['sharded']['reduce_scatter_wire_bytes'] > 0, wu
+    assert wu['sharded']['all_gather_wire_bytes'] > 0, wu
+    assert wu['replicated']['update_sharded_vars'] == 0, wu
+    assert wu['opt_slot_bytes_reduction'] >= 2.0, wu
+    assert wu['state_max_abs_diff'] < 1e-5, wu
+    pred = wu['sharded']['predicted']
+    assert pred['step_time_s'] > 0 and pred['peak_bytes'] > 0, wu
+    assert pred['optimizer_bytes'] < \
+        wu['replicated']['opt_slot_bytes_per_device'], wu
     # ISSUE 11: every record carries the telemetry block under its
     # stable key — the on-vs-off overhead A/B, a multi-worker Chrome
     # trace whose step spans align on step ids, a clean conformance

@@ -8,8 +8,8 @@ of the bench trajectory.
 Each BENCH_r*.json is either the driver wrapper (``{'parsed': {...}}``)
 or bench.py's raw output line. The comparison walks a curated metric
 table grouped by the stable record keys (grad_sync, quantized,
-hierarchical, elastic, ps_pipeline, telemetry, monitor, analysis,
-top-level throughput) with a per-metric direction; a NEW value worse
+hierarchical, weight_update, elastic, ps_pipeline, telemetry,
+monitor, analysis, top-level throughput) with a per-metric direction; a NEW value worse
 than OLD by
 more than ``--threshold`` (fractional, default 0.10) is a REGRESSION.
 Metrics missing from either record are reported as skipped, never
@@ -42,6 +42,13 @@ METRICS = (
      'higher', 'int8 PS push-byte reduction'),
     ('hierarchical', 'extra.hierarchical.dcn_bytes_reduction', 'higher',
      'two-level DCN byte reduction'),
+    ('weight_update', 'extra.weight_update.opt_slot_bytes_reduction',
+     'higher', 'weight-update opt-slot memory reduction'),
+    ('weight_update', 'extra.weight_update.sharded.per_step_wall_s',
+     'lower', 'sharded-update per-step wall'),
+    ('weight_update',
+     'extra.weight_update.sharded.all_gather_wire_bytes', 'lower',
+     'weight-update param all-gather wire bytes'),
     ('elastic', 'extra.elastic.admit_wall_s', 'lower',
      'elastic admit wall time'),
     ('elastic', 'extra.elastic.steps_blocked', 'lower',
