@@ -103,6 +103,38 @@ def _max_workers(name, raw):
     return val
 
 
+def _roofline_peaks(name, raw):
+    """Validated env parse for the roofline peak-table override:
+    ``flops=<FLOP/s>[,hbm_gbps=<GB/s>]`` (either key alone is fine).
+    Returns ``{}`` when unset, else a dict with the given keys as
+    positive finite floats — a malformed override must fail at parse
+    time naming the field, not mid-bench as a nonsense MFU."""
+    import math
+    if not raw:
+        return {}
+    out = {}
+    for part in raw.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition('=')
+        key = key.strip()
+        if not sep or key not in ('flops', 'hbm_gbps'):
+            raise ValueError(
+                "%s entries must be flops=<FLOP/s> or hbm_gbps=<GB/s>; "
+                'got %r' % (name, part))
+        try:
+            fval = float(val)
+        except ValueError:
+            raise ValueError('%s.%s must be a number; got %r'
+                             % (name, key, val)) from None
+        if not math.isfinite(fval) or fval <= 0:
+            raise ValueError('%s.%s must be a positive finite number; '
+                             'got %r' % (name, key, val))
+        out[key] = fval
+    return out
+
+
 def _choice(name, raw, default, allowed):
     """Validated env parse: one of a closed set of strings."""
     if not raw:
@@ -405,6 +437,29 @@ class ENV(Enum):
     # constants, exactly the pre-monitor behavior.
     AUTODIST_RECALIBRATE_EVERY = \
         (lambda v: _min_int('AUTODIST_RECALIBRATE_EVERY', v, 0, lo=0),)
+    # Device-plane roofline observatory (telemetry/roofline.py):
+    # '1'/'True' turns on per-step MFU/regime accounting in the session
+    # — FLOPs + bytes-accessed pulled once per compiled step
+    # (cost_analysis() on the lowered program, cached per compilation),
+    # divided by the measured step wall and the topology's peak table,
+    # emitted as the 'mfu' / roofline telemetry series plus
+    # mfu_regression flight events. Off (default) = zero per-step cost.
+    # Forwarded: a cohort roofline needs every worker accounting, and
+    # divergent sampling cadence would skew cross-worker comparison.
+    AUTODIST_ROOFLINE = (lambda v: (v == 'True' or v == '1'),)
+    # Sampling cadence (train steps) of the per-step roofline
+    # accounting — the wall-clock divide and series append run every
+    # Nth executed train step (the cost-analysis pull is once per
+    # compilation regardless).
+    AUTODIST_ROOFLINE_EVERY = \
+        (lambda v: _min_int('AUTODIST_ROOFLINE_EVERY', v, 1, lo=1),)
+    # Peak-table override: 'flops=<FLOP/s>,hbm_gbps=<GB/s>' (either key
+    # alone works) replaces the resolved Topology peaks — for device
+    # kinds the table lags, or derated-clock deployments. Validated at
+    # parse time; forwarded so every worker grades MFU against the
+    # same denominator.
+    AUTODIST_ROOFLINE_PEAKS = \
+        (lambda v: _roofline_peaks('AUTODIST_ROOFLINE_PEAKS', v),)
 
     @property
     def val(self):

@@ -229,9 +229,10 @@ def pack_buckets(items, cap_bytes, max_vars=0):
 def _emit_bucket_tag(entry):
     """Telemetry tag for one emitted sync bucket (trace-time, so this
     fires once per compiled step, not per executed step): schedule
-    shape (flat vs two-level), wire dtype and byte count — the
-    per-bucket emission evidence the cohort timeline pairs with the
-    measured step spans. No-op when telemetry is disabled."""
+    shape (flat vs two-level), wire dtype, byte count and the
+    schedule entry id — the per-bucket emission evidence the cohort
+    timeline (and the roofline drift table) pairs with the measured
+    step spans. No-op when telemetry is disabled."""
     tel = _telemetry.get()
     if not tel.enabled:
         return
@@ -242,9 +243,45 @@ def _emit_bucket_tag(entry):
     schedule = 'hier' if entry.get('hier') else 'flat'
     tel.event('bucket_emit', kind=entry['kind'], group=entry['group'],
               schedule=schedule, wire=wire, vars=entry['vars'],
-              bytes=entry['bytes'])
+              bytes=entry['bytes'],
+              entry_id=entry.get('entry_id', ''))
     tel.count('plan/buckets_emitted')
     tel.count('plan/bucket_%s' % schedule)
+
+
+def schedule_entry_key(entry):
+    """Content key of one collective-schedule entry — THE join key
+    between the static schedule (``static_collective_schedule``), the
+    traced emission records (``ExecutionPlan.last_bucket_stats``) and
+    the roofline observatory's per-entry drift table
+    (:mod:`autodist_tpu.telemetry.roofline`). Built only from fields
+    both sides carry identically (kind, dtype, compressor, byte count,
+    leading member + member count); ``phase`` is deliberately excluded
+    — the traced records do not know it, and kind already separates
+    the grad/param halves of every pair the schedule emits."""
+    members = entry.get('members') or []
+    return '%s:%s:%s:%dB:%s+%d' % (
+        entry['kind'], entry.get('dtype'),
+        entry.get('compressor') or '-', int(entry.get('bytes', 0)),
+        members[0] if members else '?', len(members))
+
+
+def assign_entry_ids(entries, counts=None):
+    """Stamp each entry with a stable ``entry_id``: its content key,
+    suffixed ``#k`` for the k-th repeat of an identical key (equal-size
+    ZeRO chunks of one variable). Deterministic given emission order,
+    which both emission paths pin — so an id minted by the traced
+    emission round-trips to exactly one static-schedule entry.
+    ``counts`` threads the occurrence map across multiple calls within
+    ONE trace (the param-gather records land after sync_gradients
+    returns). Returns ``entries`` (mutated in place)."""
+    counts = {} if counts is None else counts
+    for e in entries:
+        key = schedule_entry_key(e)
+        k = counts.get(key, 0)
+        counts[key] = k + 1
+        e['entry_id'] = key if k == 0 else '%s#%d' % (key, k)
+    return entries
 
 
 def static_collective_schedule(strategy, graph_item, num_replicas,
@@ -272,7 +309,10 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     buckets) and ``wus`` marks the reduce-scatter + all-gather pair a
     weight-update-sharded bucket lowers to
     (``choose_update_sharding``, the shared decision — padded bytes,
-    sharded opt slots).
+    sharded opt slots). Every entry additionally carries a stable
+    ``entry_id`` (:func:`assign_entry_ids` over
+    :func:`schedule_entry_key`) that the traced emission records and
+    the roofline drift table join on.
     ``bytes``
     are RAW tensor bytes; anything REPORTING traffic must route them
     through ``simulator.cost_model.wire_bytes`` (as the cost model,
@@ -469,7 +509,7 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
             'bytes': nbytes,
             'members': [sources[i].name for i in bucket],
             'phase': 'grad', 'hier': hier, 'wus': False})
-    return entries
+    return assign_entry_ids(entries)
 
 
 class ShardedGrad:
@@ -730,8 +770,12 @@ class ExecutionPlan:
         # and utils/profiling.bucket_report attach the wire figure via
         # simulator.cost_model.wire_bytes so the bucket layout (and the
         # overlap + compression it enables) is auditable without
-        # reading HLO.
+        # reading HLO. Each record carries the schedule 'entry_id'
+        # (assign_entry_ids over the shared content key), which
+        # round-trips to static_collective_schedule — the join the
+        # roofline drift table runs on.
         self.last_bucket_stats = []
+        self._entry_id_counts = {}
         # loose-mode gate: any sync=True var demands its staleness bound;
         # the program-wide gate enforces the tightest one (per-variable
         # windows collapse to one window since the step is one program).
@@ -756,6 +800,15 @@ class ExecutionPlan:
     def plan_for(self, var):
         name = var if isinstance(var, str) else var.name
         return self.var_plans[name]
+
+    def _record_entry(self, entry):
+        """Append one traced emission record, stamped with its
+        schedule entry id (the occurrence map persists across the
+        whole trace — sync_gradients resets it, the param-gather
+        records reuse it), and emit its telemetry tag."""
+        assign_entry_ids([entry], self._entry_id_counts)
+        self.last_bucket_stats.append(entry)
+        _emit_bucket_tag(entry)
 
     # -- gradient synchronization (runs inside shard_map) -----------------
     def _reduce_fn(self, spec, hier_groups=None):
@@ -938,13 +991,12 @@ class ExecutionPlan:
             groups = self._hier_groups_for(int(nb), str(x.dtype),
                                            'NoneCompressor', plan.spec,
                                            plan.hierarchical)
-            self.last_bucket_stats.append({
+            self._record_entry({
                 'kind': 'psum_scatter', 'group': None,
                 'compressor': None, 'dtype': str(x.dtype),
                 'spec': plan.spec, 'vars': 1, 'bytes': int(nb),
                 'members': [plan.var.name],
                 'hier': len(groups) if groups else 0})
-            _emit_bucket_tag(self.last_bucket_stats[-1])
             if groups:
                 return hierarchical_psum_scatter(
                     x, AXIS_DATA, groups, axis=axis) / n
@@ -981,6 +1033,7 @@ class ExecutionPlan:
         reduce-scatters are chunked under the same cap.
         """
         self.last_bucket_stats = []
+        self._entry_id_counts = {}
         if self.num_replicas == 1:
             return grads
         n = self.num_replicas
@@ -1061,13 +1114,12 @@ class ExecutionPlan:
                 continue
             groups = self._hier_groups_for(nbytes, dtype, cname, spec,
                                            hknob)
-            self.last_bucket_stats.append({
+            self._record_entry({
                 'kind': 'all_reduce', 'group': group,
                 'compressor': cname, 'dtype': dtype, 'spec': spec,
                 'vars': len(bucket), 'bytes': nbytes,
                 'members': [sources[i].name for i in bucket],
                 'hier': len(groups) if groups else 0})
-            _emit_bucket_tag(self.last_bucket_stats[-1])
             if len(bucket) == 1 and groups is None:
                 i = bucket[0]
                 plan = self.plan_for(sources[i])
@@ -1184,13 +1236,12 @@ class ExecutionPlan:
                 'hier_groups': groups,
                 'group': group, 'compressor': cname, 'dtype': dtype,
                 'spec': spec, 'bytes': padded_bytes}
-        self.last_bucket_stats.append({
+        self._record_entry({
             'kind': 'psum_scatter', 'group': group,
             'compressor': cname, 'dtype': dtype, 'spec': spec,
             'vars': len(bucket), 'bytes': padded_bytes,
             'members': list(meta['members']),
             'hier': len(groups) if groups else 0, 'wus': True})
-        _emit_bucket_tag(self.last_bucket_stats[-1])
         out, off = [], 0
         for pos, (i, m) in enumerate(zip(bucket, shard_sizes)):
             out.append((i, UpdateShard(shard[off:off + m], self,
@@ -1222,7 +1273,7 @@ class ExecutionPlan:
             if set(names) != set(members):
                 for name, sh in members.items():
                     out[name] = sh.gather()
-                    self.last_bucket_stats.append({
+                    self._record_entry({
                         'kind': 'all_gather', 'group': meta['group'],
                         'compressor': meta['compressor'],
                         'dtype': meta['dtype'], 'spec': meta['spec'],
@@ -1232,7 +1283,6 @@ class ExecutionPlan:
                         'members': [name],
                         'hier': len(meta['hier_groups'])
                         if meta['hier_groups'] else 0, 'wus': True})
-                    _emit_bucket_tag(self.last_bucket_stats[-1])
                 continue
             cat = jnp.concatenate([members[nm].value for nm in names])
             groups = meta['hier_groups']
@@ -1240,14 +1290,13 @@ class ExecutionPlan:
                 full = hierarchical_all_gather(cat, AXIS_DATA, groups)
             else:
                 full = jax.lax.all_gather(cat, AXIS_DATA, tiled=True)
-            self.last_bucket_stats.append({
+            self._record_entry({
                 'kind': 'all_gather', 'group': meta['group'],
                 'compressor': meta['compressor'],
                 'dtype': meta['dtype'], 'spec': meta['spec'],
                 'vars': len(names), 'bytes': meta['bytes'],
                 'members': list(names),
                 'hier': len(groups) if groups else 0, 'wus': True})
-            _emit_bucket_tag(self.last_bucket_stats[-1])
             mat = full.reshape(self.num_replicas, -1)
             off = 0
             for nm, m in zip(names, meta['shard_sizes']):

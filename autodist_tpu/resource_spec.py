@@ -59,6 +59,26 @@ _ICI_DEFAULTS = {
 }
 _DCN_DEFAULT_LATENCY_US = 30.0
 
+#: Per-device-KIND roofline peaks: (dense bf16 peak FLOP/s, peak HBM
+#: bandwidth GB/s) from public spec sheets — the denominator of the
+#: device-plane MFU/roofline accounting (telemetry/roofline.py).
+#: Matching follows KNOWN_DEVICE_KINDS (substring, first match wins).
+#: ``None`` entries mean "no meaningful peak": a CPU host's virtual
+#: devices have no spec-sheet FLOPs ceiling, so MFU degrades to an
+#: explicit null instead of a number against a made-up denominator.
+#: bench.py's headline-MFU table reads the same entries.
+PEAKS_BY_KIND = {
+    'v6': (918e12, 1640.0),
+    'v5p': (459e12, 2765.0),
+    'v5e': (197e12, 819.0),
+    'v5': (197e12, 819.0),
+    'v4': (275e12, 1228.0),
+    'v3': (123e12, 900.0),
+    'v2': (46e12, 700.0),
+    'gpu': (125e12, 900.0),
+    'cpu': (None, None),
+}
+
 
 class Topology:
     """Validated ICI/DCN link model for the strategy simulator.
@@ -71,20 +91,27 @@ class Topology:
           dcn_bandwidth_gbps: 12.5  # GB/s per device, cross-slice/node
           dcn_latency_us: 30
           device_kind: v5e          # optional, one of KNOWN_DEVICE_KINDS
+          peak_flops: 1.97e14       # optional, dense bf16 FLOP/s/chip
+          peak_hbm_gbps: 819        # optional, HBM GB/s/chip
 
     Missing fields default from the spec's device types (ICI) and the
-    per-node ``network_bandwidth`` (DCN: GBE is gigaBITs, so /8).
-    All fields are validated at parse time — the simulator consumes
+    per-node ``network_bandwidth`` (DCN: GBE is gigaBITs, so /8); the
+    roofline peaks default from the ``device_kind`` row of
+    :data:`PEAKS_BY_KIND` and may resolve to None (CPU hosts have no
+    meaningful peak — MFU reports an explicit null, never a number
+    against an invented denominator). All fields are validated at
+    parse time — the simulator and the roofline observatory consume
     them blindly.
     """
 
     _NUMERIC_FIELDS = ('ici_bandwidth_gbps', 'ici_latency_us',
                        'dcn_bandwidth_gbps', 'dcn_latency_us')
+    _PEAK_FIELDS = ('peak_flops', 'peak_hbm_gbps')
 
     def __init__(self, info, accel_type, min_net_bandwidth_gbe,
                  multi_node):
         info = dict(info or {})
-        for field in self._NUMERIC_FIELDS:
+        for field in self._NUMERIC_FIELDS + self._PEAK_FIELDS:
             val = info.get(field)
             if val is None:
                 continue
@@ -103,11 +130,14 @@ class Topology:
                 raise ValueError(
                     'topology.device_kind %r is not a known device type '
                     '(known: %s)' % (kind, ', '.join(KNOWN_DEVICE_KINDS)))
-        unknown = set(info) - set(self._NUMERIC_FIELDS) - {'device_kind'}
+        unknown = set(info) - set(self._NUMERIC_FIELDS) \
+            - set(self._PEAK_FIELDS) - {'device_kind'}
         if unknown:
             raise ValueError(
-                'Unknown topology field(s) %s (known: %s, device_kind)'
-                % (sorted(unknown), ', '.join(self._NUMERIC_FIELDS)))
+                'Unknown topology field(s) %s (known: %s, %s, '
+                'device_kind)'
+                % (sorted(unknown), ', '.join(self._NUMERIC_FIELDS),
+                   ', '.join(self._PEAK_FIELDS)))
         # device_kind refines the ICI defaults by TPU generation
         if matched_kind is not None:
             ici_bw, ici_lat = _ICI_BY_KIND[matched_kind]
@@ -122,6 +152,20 @@ class Topology:
                      max(min_net_bandwidth_gbe, 0.001) / 8.0))
         self.dcn_latency_us = float(
             info.get('dcn_latency_us', _DCN_DEFAULT_LATENCY_US))
+        # roofline peaks: explicit fields override the per-kind table;
+        # with no matched kind the type default is 'gpu' / 'cpu' class
+        if matched_kind is not None:
+            peak_flops, peak_hbm = PEAKS_BY_KIND[matched_kind]
+        elif accel_type is DeviceType.TPU:
+            peak_flops, peak_hbm = PEAKS_BY_KIND['v5e']
+        elif accel_type is DeviceType.GPU:
+            peak_flops, peak_hbm = PEAKS_BY_KIND['gpu']
+        else:
+            peak_flops, peak_hbm = PEAKS_BY_KIND['cpu']
+        pf = info.get('peak_flops', peak_flops)
+        ph = info.get('peak_hbm_gbps', peak_hbm)
+        self.peak_flops = float(pf) if pf is not None else None
+        self.peak_hbm_gbps = float(ph) if ph is not None else None
         self.multi_node = bool(multi_node)
         # Re-validate the RESOLVED link constants, not just the raw
         # fields: the simulator divides by link() bandwidth with no
@@ -136,6 +180,30 @@ class Topology:
                 raise ValueError(
                     'topology.%s must resolve to a positive finite '
                     'number, got %r' % (field, val))
+        # roofline peaks get the same resolved check, except that None
+        # (no meaningful peak for this device kind — CPU hosts) is a
+        # legitimate resolution the MFU accounting degrades on
+        for field in self._PEAK_FIELDS:
+            val = getattr(self, field)
+            if val is not None and (not math.isfinite(val) or val <= 0):
+                raise ValueError(
+                    'topology.%s must resolve to a positive finite '
+                    'number (or be omitted), got %r' % (field, val))
+
+    def peaks(self):
+        """(peak FLOP/s, peak HBM bytes/s) — either may be None when
+        the device kind has no meaningful spec-sheet peak (MFU then
+        reports an explicit null). The ``AUTODIST_ROOFLINE_PEAKS`` env
+        override (validated at parse time in const.py) takes precedence
+        over both the explicit fields and the per-kind defaults, like
+        the other traced-program overrides."""
+        from autodist_tpu.const import ENV
+        forced = ENV.AUTODIST_ROOFLINE_PEAKS.val
+        pf, ph = self.peak_flops, self.peak_hbm_gbps
+        if forced:
+            pf = forced.get('flops', pf)
+            ph = forced.get('hbm_gbps', ph)
+        return pf, (ph * 1e9 if ph is not None else None)
 
     def link(self, cross_node=False):
         """(bytes/s, seconds) for one link class.

@@ -51,6 +51,11 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # the optimizer-slot layout are part of the traced
                     # program — every SPMD host must agree
                     ENV.AUTODIST_WEIGHT_UPDATE_SHARDING,
+                    # roofline observatory: every worker must account
+                    # MFU on the same cadence against the same peak
+                    # denominator or the cohort comparison skews
+                    ENV.AUTODIST_ROOFLINE, ENV.AUTODIST_ROOFLINE_EVERY,
+                    ENV.AUTODIST_ROOFLINE_PEAKS,
                     # bucket layout + overlap flags must agree on every
                     # traced host — divergent HLO across SPMD deadlocks
                     ENV.AUTODIST_BUCKET_BYTES, ENV.AUTODIST_XLA_OVERLAP,

@@ -289,11 +289,14 @@ class Session:
     _step_walls = _LazyDefault(
         lambda: _deque(maxlen=ENV.AUTODIST_TELEMETRY_MAX_SPANS.val),
         '_step_walls')
-    # stub sessions (__new__) have no sentry and no telemetry push
-    # lane; real ones bind in __init__
+    # stub sessions (__new__) have no sentry, no telemetry push lane
+    # and no roofline tracker; real ones bind in __init__
     _monitor = None
     _tel_pipe = None
     _tel_push_handle = None
+    _roofline_tracker = None
+    _last_step_cost = None
+    _last_exec_wall = 0.0
 
     def __init__(self, graph_item, plan, cluster=None, coord=None):
         self._graph_item = graph_item
@@ -485,6 +488,34 @@ class Session:
                 # our own batches are tapped at drain time, never
                 # fetched back off the wire (ingest_local)
                 local_worker=self._worker_name)
+        # -- device-plane roofline observatory (per-worker) ------------
+        # AUTODIST_ROOFLINE: per-step MFU/regime accounting — FLOPs +
+        # bytes-accessed from the compiled step (cost_analysis() on
+        # the lowered program, computed once per compilation below)
+        # over the measured wall and the topology's peak table.
+        # Samples land on the telemetry series, feed the monitor's
+        # compute/memory-bound verdict refinement, and a drop below
+        # the rolling baseline records an mfu_regression flight event.
+        self._roofline_tracker = None
+        self._roofline_costs = {}
+        self._last_step_cost = None
+        self._last_exec_wall = 0.0
+        if ENV.AUTODIST_ROOFLINE.val:
+            from autodist_tpu.telemetry.roofline import RooflineTracker
+            rs = getattr(cluster, '_resource_spec', None)
+            topo = rs.topology if rs is not None else \
+                getattr(plan, 'topology', None)
+            if topo is not None:
+                peak_flops, peak_hbm = topo.peaks()
+            else:
+                forced = ENV.AUTODIST_ROOFLINE_PEAKS.val
+                peak_flops = forced.get('flops')
+                peak_hbm = forced.get('hbm_gbps')
+                peak_hbm = peak_hbm * 1e9 if peak_hbm else None
+            self._roofline_tracker = RooflineTracker(
+                peak_flops=peak_flops, peak_hbm_bps=peak_hbm,
+                tel=self._tel, flight=self._flight,
+                worker=self._worker_name)
         # chief-side auto-checkpoint backstop: with restarts in play the
         # PS state is authoritative, but a periodic chief snapshot
         # bounds the blast radius of losing the PS itself
@@ -1909,6 +1940,20 @@ class Session:
                 self._tel.record_span('step', t0, wall,
                                       step=self._step_count,
                                       worker=self._worker_name)
+            if self._roofline_tracker is not None:
+                # exposed comms for the regime split: in loose mode the
+                # wall beyond the compiled step's execution is the
+                # gate/pull/push wire time; inside one SPMD program
+                # collectives are part of the device step, so None
+                # (the regime then splits compute vs memory only)
+                comms = max(0.0, wall - self._last_exec_wall) \
+                    if self._loose and self._last_exec_wall else None
+                rec = self._roofline_tracker.observe_step(
+                    self._step_count, wall, cost=self._last_step_cost,
+                    comms_s=comms)
+                if rec is not None and self._monitor is not None:
+                    self._monitor.observe_roofline(self._worker_name,
+                                                   rec)
             if self._monitor is not None:
                 self._monitor.observe_step(self._worker_name,
                                            self._step_count, wall)
@@ -2054,13 +2099,42 @@ class Session:
             placed.append(self._put_feed(v, P(AXIS_DATA) if split
                                          else P()))
 
-        if first_compile and ENV.AUTODIST_DUMP_GRAPHS.val:
+        # dump-graphs and the roofline cost pull share ONE extra
+        # lowering of the step (re-tracing a large step costs real
+        # host seconds — never pay it twice, and only ever once per
+        # compile key)
+        lowered = None
+        need_cost = self._roofline_tracker is not None and \
+            key not in self._roofline_costs
+        if (first_compile and ENV.AUTODIST_DUMP_GRAPHS.val) or \
+                need_cost:
+            try:
+                lowered = fn.lower(self._var_state, self._opt_state,
+                                   self._aux_state, placed)
+            except Exception as e:  # noqa: BLE001 - never fatal:
+                # both consumers are observability, not execution
+                logging.debug('step lowering for dump/roofline '
+                              'failed (%s: %s)', type(e).__name__, e)
+        if first_compile and ENV.AUTODIST_DUMP_GRAPHS.val and \
+                lowered is not None:
             # final-phase program dump (reference '3-transformed' graph)
             from autodist_tpu.utils import visualization as viz
-            viz.log_compiled(
-                fn.lower(self._var_state, self._opt_state,
-                         self._aux_state, placed),
-                '4-lowered-step-%d' % len(self._cache))
+            viz.log_compiled(lowered,
+                             '4-lowered-step-%d' % len(self._cache))
+
+        if self._roofline_tracker is not None:
+            # FLOPs + bytes-accessed once per compilation
+            # (cost_analysis on the lowered program — no backend
+            # compile; cost_of caches per program), so the per-step
+            # sampling in run() is pure arithmetic. Graceful: a
+            # backend without cost_analysis leaves flops None and
+            # every sampled record explains its null MFU.
+            if need_cost:
+                from autodist_tpu.telemetry import roofline as _roofline
+                self._roofline_costs[key] = _roofline.cost_of(lowered) \
+                    if lowered is not None else \
+                    {'flops': None, 'bytes_accessed': None}
+            self._last_step_cost = self._roofline_costs[key]
 
         tracing = options is not None and \
             getattr(options, 'trace_level', 0) > 0
@@ -2081,6 +2155,7 @@ class Session:
                              options.trace_dir)
         if is_train:
             self._step_count += 1
+            self._last_exec_wall = _time.perf_counter() - t_step
             if self._loose:
                 with self._stats_lock:
                     self._ps_phase['step_s'] += \

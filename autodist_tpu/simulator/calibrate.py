@@ -170,6 +170,102 @@ def fit_alpha_beta(samples, num_replicas):
     return float(max(alpha, 0.0)), float(beta)
 
 
+def samples_from_drift(table):
+    """Entry-labeled ``(ici, dcn)`` sample lists from a roofline
+    drift table (:func:`autodist_tpu.telemetry.roofline.drift_table`).
+
+    Each sample is ``(full_buffer_bytes, hlo kind, seconds,
+    group_size)`` — tier-labeled BY THE SCHEDULE ENTRY, not by the
+    replica-groups heuristic, and carrying the schedule's FULL buffer
+    bytes rather than the HLO result shape. That second point is the
+    correctness fix: a reduce-scatter's HLO result is the 1/n shard,
+    so the unlabeled path (:func:`tiered_samples_from_timeline` /
+    :func:`samples_from_timeline`) feeds ``B/n`` into a cost shape
+    priced over ``B`` and fits a β inflated by ``n`` — a ZeRO or
+    weight-update-sharded trace calibrated through it overprices
+    every reduce-scatter/all-gather by the replica count
+    (``tests/test_roofline.py`` pins the divergence).
+    """
+    ici, dcn = [], []
+    for tier, full_b, hlo_kind, seconds, group in \
+            (table or {}).get('samples', ()):
+        row = (full_b, hlo_kind, seconds, group)
+        (dcn if tier == 'dcn' else ici).append(row)
+    return ici, dcn
+
+
+def calibrate_from_drift(params, table, num_replicas,
+                         devices_per_node=0):
+    """Refined copy of ``params`` from an entry-labeled drift table —
+    the roofline observatory's replacement for the unlabeled-row
+    heuristic classification.
+
+    The ICI and DCN tiers are fitted from the table's entry-labeled
+    samples (:func:`samples_from_drift`) under the same
+    fallback rules as :func:`calibrate_from_timeline`'s tiered path:
+    a tier with a degenerate fit borrows the group-aware shared fit,
+    a tier ABSENT from the table keeps its analytic constants, and an
+    empty table returns ``params`` untouched (warned).
+    """
+    ici, dcn = samples_from_drift(table)
+    if not (ici or dcn):
+        logging.warning(
+            'calibrate: drift table carries no joinable samples — '
+            'keeping analytic α-β constants')
+        return params
+    shared = fit_alpha_beta(ici + dcn, num_replicas)
+    return _apply_tier_fits(params, ici, dcn, shared, num_replicas,
+                            devices_per_node or num_replicas)
+
+
+def _apply_tier_fits(params, ici, dcn, shared, num_replicas,
+                     devices_per_node):
+    """Per-tier least-squares application with the shared-fit /
+    analytic fallback rules (the one implementation behind
+    :func:`calibrate_from_timeline`'s tiered path and
+    :func:`calibrate_from_drift`)."""
+    import dataclasses
+
+    fit_i = fit_alpha_beta(ici, devices_per_node) if ici else None
+    fit_d = fit_alpha_beta(dcn, num_replicas) if dcn else None
+    out = params
+    for tier, fit, nrows in (('ICI', fit_i, len(ici)),
+                             ('DCN', fit_d, len(dcn))):
+        if fit is None:
+            # a tier with SOME rows but a degenerate fit borrows
+            # the group-aware shared fit (its own rows are in it);
+            # a tier ABSENT from the trace keeps its analytic
+            # constants — assigning an all-DCN shared fit to an
+            # unmeasured ICI tier would make the model reject
+            # every two-level schedule, the opposite of what
+            # calibration is for
+            if nrows == 0 or shared is None:
+                logging.info(
+                    'calibrate: %s tier has no usable fit (%d '
+                    'rows%s) — keeping its analytic constants',
+                    tier, nrows,
+                    '' if nrows else ', tier absent from trace')
+                continue
+            logging.info(
+                'calibrate: %s tier has too few samples (%d '
+                'rows); falling back to the shared fit', tier,
+                nrows)
+            fit = shared
+        alpha, beta = fit
+        if tier == 'DCN':
+            out = dataclasses.replace(
+                out, alpha_dcn_s=alpha, beta_dcn_s_per_byte=beta,
+                calibrated=True)
+        else:
+            out = dataclasses.replace(
+                out, alpha_ici_s=alpha, beta_ici_s_per_byte=beta,
+                calibrated=True)
+        logging.info(
+            'calibrate: fitted %s tier alpha=%.3gs beta=%.3gs/B '
+            '(%d rows)', tier, alpha, beta, nrows)
+    return out
+
+
 def calibrate_from_timeline(params, timeline, num_replicas,
                             cross_node=False, devices_per_node=0):
     """Refined copy of ``params`` from collective timeline rows.
@@ -197,47 +293,12 @@ def calibrate_from_timeline(params, timeline, num_replicas,
     if devices_per_node and devices_per_node > 1:
         ici, dcn = tiered_samples_from_timeline(timeline or [],
                                                 devices_per_node)
-        fit_i = fit_alpha_beta(ici, devices_per_node) if ici else None
-        fit_d = fit_alpha_beta(dcn, num_replicas) if dcn else None
         # the tier fallback inverts through each row's OWN group size
         # (a group-aware shared fit), not the legacy flat-n assumption
         shared = fit_alpha_beta(ici + dcn, num_replicas) or shared \
             if (ici or dcn) else shared
-        out = params
-        for tier, fit, nrows in (('ICI', fit_i, len(ici)),
-                                 ('DCN', fit_d, len(dcn))):
-            if fit is None:
-                # a tier with SOME rows but a degenerate fit borrows
-                # the group-aware shared fit (its own rows are in it);
-                # a tier ABSENT from the trace keeps its analytic
-                # constants — assigning an all-DCN shared fit to an
-                # unmeasured ICI tier would make the model reject
-                # every two-level schedule, the opposite of what
-                # calibration is for
-                if nrows == 0 or shared is None:
-                    logging.info(
-                        'calibrate: %s tier has no usable fit (%d '
-                        'rows%s) — keeping its analytic constants',
-                        tier, nrows,
-                        '' if nrows else ', tier absent from trace')
-                    continue
-                logging.info(
-                    'calibrate: %s tier has too few samples (%d '
-                    'rows); falling back to the shared fit', tier,
-                    nrows)
-                fit = shared
-            alpha, beta = fit
-            if tier == 'DCN':
-                out = dataclasses.replace(
-                    out, alpha_dcn_s=alpha, beta_dcn_s_per_byte=beta,
-                    calibrated=True)
-            else:
-                out = dataclasses.replace(
-                    out, alpha_ici_s=alpha, beta_ici_s_per_byte=beta,
-                    calibrated=True)
-            logging.info(
-                'calibrate: fitted %s tier alpha=%.3gs beta=%.3gs/B '
-                '(%d rows)', tier, alpha, beta, nrows)
+        out = _apply_tier_fits(params, ici, dcn, shared, num_replicas,
+                               devices_per_node)
         if not out.calibrated:
             logging.warning(
                 'calibrate: no usable collective samples in either '
@@ -267,15 +328,21 @@ def calibrate_from_timeline(params, timeline, num_replicas,
 
 def calibrate_from_trace(params, trace_dir, num_replicas,
                          cross_node=False, line_name='XLA Ops',
-                         devices_per_node=0):
+                         devices_per_node=0, expected_collectives=0):
     """Refined copy of ``params`` from a captured profiler trace dir
     (``Trainer.profile`` / ``RunOptions`` output). Degrades to the
     analytic constants when the trace has no collective rows (e.g.
     CPU-fallback runs, where profiling.collective_timeline itself
     degrades to empty). ``devices_per_node`` > 1 fits the ICI and DCN
-    tiers separately (see :func:`calibrate_from_timeline`)."""
+    tiers separately (see :func:`calibrate_from_timeline`).
+    ``expected_collectives`` (the plan's statically-known emission
+    count, e.g. ``len(grad_bucket_layout(...))``) makes a
+    zero-collective parse on a run that emitted buckets log loudly
+    instead of silently keeping analytic constants."""
     from autodist_tpu.utils.profiling import collective_timeline
-    timeline = collective_timeline(trace_dir, line_name=line_name)
+    timeline = collective_timeline(
+        trace_dir, line_name=line_name,
+        expected_collectives=expected_collectives)
     return calibrate_from_timeline(params, timeline, num_replicas,
                                    cross_node=cross_node,
                                    devices_per_node=devices_per_node)

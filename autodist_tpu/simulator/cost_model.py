@@ -416,6 +416,44 @@ def num_node_groups(strategy=None, resource_spec=None, num_replicas=None):
     return k
 
 
+def entry_time(e, n, params, cross_node=False):
+    """Predicted seconds (pre-overlap) + wire bytes for ONE schedule
+    entry — the per-entry pricing :func:`predict` sums and the
+    roofline observatory's drift table compares achieved timings
+    against (:mod:`autodist_tpu.telemetry.roofline`), factored out so
+    the two can never price the same entry differently.
+
+    Returns ``(seconds, wire_bytes)``. Two-level (``hier``) entries
+    ride :func:`hierarchical_time`/:func:`hierarchical_half_time`
+    (int8 buckets' intra phases at raw f32 bytes); compressed wires
+    pay the cast/quantize HBM passes on top.
+    """
+    wb = wire_bytes(e['bytes'], e['dtype'], e.get('compressor'))
+    hier = int(e.get('hier', 0))
+    alpha, beta = params.link(cross_node=cross_node)
+    if hier > 1 and e['kind'] == 'all_reduce':
+        # two-level schedule: ICI phases + DCN phase + boundary.
+        # int8 buckets quantize only at the tier boundary, so
+        # their intra phases move the raw f32 bytes on ICI.
+        ici_b = e['bytes'] \
+            if e.get('compressor') == 'Int8RingCompressor' else wb
+        t = hierarchical_time(wb, n, hier, params, ici_bytes=ici_b)
+    elif hier > 1 and e['kind'] in ('psum_scatter', 'all_gather'):
+        # a two-level ZeRO / update-sharding HALF: exactly half of
+        # the two-level all-reduce (phase symmetry), so the same
+        # choose_hierarchical decision applies
+        t = hierarchical_half_time(wb, n, hier, params)
+    else:
+        t = collective_time(e['kind'], wb, n, alpha, beta)
+    if wb < e['bytes']:   # compressor cast: two HBM passes per end
+        t += e['bytes'] * params.compress_s_per_byte
+    if e.get('compressor') == 'Int8RingCompressor':
+        # block quantization: max-abs scan + scale divide + the
+        # ring's per-hop requantization — extra HBM passes
+        t += e['bytes'] * params.quant_s_per_byte
+    return t, wb
+
+
 @dataclass
 class CostReport:
     """Per-strategy prediction: step time, sync decomposition, memory."""
@@ -524,7 +562,6 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         params = CostModelParams.from_topology(resource_spec.topology)
     if resource_spec is not None:
         cross_node = resource_spec.topology.multi_node
-    alpha, beta = params.link(cross_node=cross_node)
     if nodes is None:
         nodes = num_node_groups(strategy, resource_spec, n)
 
@@ -546,28 +583,8 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
     last_grad_ar = grad_ar[-1] if grad_ar else -1
     exposed = 0.0
     for i, e in enumerate(schedule):
-        wb = wire_bytes(e['bytes'], e['dtype'], e.get('compressor'))
+        t, wb = entry_time(e, n, params, cross_node=cross_node)
         hier = int(e.get('hier', 0))
-        if hier > 1 and e['kind'] == 'all_reduce':
-            # two-level schedule: ICI phases + DCN phase + boundary.
-            # int8 buckets quantize only at the tier boundary, so
-            # their intra phases move the raw f32 bytes on ICI.
-            ici_b = e['bytes'] \
-                if e.get('compressor') == 'Int8RingCompressor' else wb
-            t = hierarchical_time(wb, n, hier, params, ici_bytes=ici_b)
-        elif hier > 1 and e['kind'] in ('psum_scatter', 'all_gather'):
-            # a two-level ZeRO / update-sharding HALF: exactly half of
-            # the two-level all-reduce (phase symmetry), so the same
-            # choose_hierarchical decision applies
-            t = hierarchical_half_time(wb, n, hier, params)
-        else:
-            t = collective_time(e['kind'], wb, n, alpha, beta)
-        if wb < e['bytes']:   # compressor cast: two HBM passes per end
-            t += e['bytes'] * params.compress_s_per_byte
-        if e.get('compressor') == 'Int8RingCompressor':
-            # block quantization: max-abs scan + scale divide + the
-            # ring's per-hop requantization — extra HBM passes
-            t += e['bytes'] * params.quant_s_per_byte
         # grad buckets before the last-emitted one overlap backward
         # compute; ZeRO scatters are conservatively priced in full.
         # Param-phase traffic (the post-update re-gather — the static

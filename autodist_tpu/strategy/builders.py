@@ -310,11 +310,20 @@ class AutoStrategy(StrategyBuilder):
             looks up per step — batch-derived (pass the per-replica
             batch size, or batch x ids-per-example); prices sparse
             variables' PS traffic by touched rows instead of full size.
+        drift_table: entry-labeled drift table from the roofline
+            observatory (``telemetry.roofline.drift_table``, or a
+            BENCH record's ``roofline.drift`` block — it carries the
+            samples). Preferred over ``trace_dir``: tiers are labeled
+            by schedule entry rather than the replica-groups
+            heuristic, and samples carry full buffer bytes, so the
+            refit β is exact for reduce-scatter/all-gather rows too
+            (``calibrate.calibrate_from_drift``).
     """
 
     def __init__(self, memory_budget_bytes=None, optimizer_slots=2,
                  candidates=None, cost_params=None, trace_dir=None,
-                 num_replicas=None, sparse_lookups_per_replica=4096):
+                 num_replicas=None, sparse_lookups_per_replica=4096,
+                 drift_table=None):
         self._budget = memory_budget_bytes
         self._optimizer_slots = optimizer_slots
         self._candidates = candidates
@@ -322,13 +331,21 @@ class AutoStrategy(StrategyBuilder):
         self._trace_dir = trace_dir
         self._num_replicas = num_replicas
         self._sparse_lookups = sparse_lookups_per_replica
+        # entry-labeled drift table from a previous run's roofline
+        # observatory (telemetry.roofline.drift_table): preferred over
+        # trace_dir — its samples are tier-labeled by schedule entry
+        # (not the replica-groups heuristic) and carry full buffer
+        # bytes (not HLO result shapes), so the refit β is exact for
+        # reduce-scatter/all-gather rows too
+        self._drift_table = drift_table
         # populated by build() for audits / bench reporting
         self.last_ranked = []
         self.last_infeasible = []
 
     def build(self, graph_item, resource_spec):
         from autodist_tpu.simulator import search
-        from autodist_tpu.simulator.calibrate import calibrate_from_trace
+        from autodist_tpu.simulator.calibrate import (
+            calibrate_from_drift, calibrate_from_trace)
         from autodist_tpu.simulator.cost_model import CostModelParams
 
         n = self._num_replicas
@@ -336,7 +353,14 @@ class AutoStrategy(StrategyBuilder):
             n = len(replica_devices(resource_spec))
         params = self._cost_params or CostModelParams.from_topology(
             resource_spec.topology)
-        if self._trace_dir:
+        if self._drift_table is not None:
+            from autodist_tpu.simulator.cost_model import num_node_groups
+            k = num_node_groups(resource_spec=resource_spec,
+                                num_replicas=n)
+            params = calibrate_from_drift(
+                params, self._drift_table, n,
+                devices_per_node=n // k if k > 1 else n)
+        elif self._trace_dir:
             from autodist_tpu.simulator.cost_model import num_node_groups
             k = num_node_groups(resource_spec=resource_spec,
                                 num_replicas=n)

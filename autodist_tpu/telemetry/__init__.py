@@ -19,7 +19,13 @@ Three layers (docs/design/observability.md):
   straggler verdicts with phase attribution, recording
   ``slowdown``/``recovered`` flight events, feeding the autoscale
   step-time signal and continuously recalibrating the cost model's
-  link constants.
+  link constants;
+- :mod:`~autodist_tpu.telemetry.roofline` — the device-plane roofline
+  observatory: per-step MFU/regime accounting from the compiled
+  step's cost analysis against the topology's validated peak table,
+  HBM measured-vs-estimated drift, and the per-entry
+  achieved-vs-predicted collective drift table (joined on schedule
+  entry ids) that ``calibrate.calibrate_from_drift`` fits.
 """
 from autodist_tpu.telemetry.aggregate import (chrome_trace,
                                               collect_new_records,
@@ -36,10 +42,17 @@ from autodist_tpu.telemetry.monitor import (CohortMonitor,
                                             format_snapshot,
                                             phase_medians,
                                             phase_splits)
+from autodist_tpu.telemetry.roofline import (RooflineTracker,
+                                             classify_regime, cost_of,
+                                             drift_table,
+                                             format_drift_table,
+                                             memory_drift, memory_of)
 
 __all__ = ['Telemetry', 'get', 'reset', 'FlightRecorder', 'recorder',
            'reset_recorder', 'telemetry_dir', 'load_dump',
            'encode_records', 'decode_records', 'push_records',
            'collect_records', 'collect_new_records', 'chrome_trace',
            'step_timeline', 'CohortMonitor', 'phase_splits',
-           'phase_medians', 'format_snapshot']
+           'phase_medians', 'format_snapshot', 'RooflineTracker',
+           'classify_regime', 'cost_of', 'memory_of', 'memory_drift',
+           'drift_table', 'format_drift_table']

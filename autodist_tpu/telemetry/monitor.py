@@ -71,7 +71,11 @@ PHASE_OF = {
 #: the derived columns, in render order ('step' is the whole wall)
 PHASES = ('gate', 'pull', 'push', 'pipeline', 'compute')
 
-#: classification per dominant excess phase
+#: classification per dominant excess phase. 'host_compute' is the
+#: host-side default; when the worker's roofline observatory
+#: (telemetry/roofline.py) has reported a device regime for it, the
+#: verdict refines to 'compute_bound' / 'memory_bound' — the
+#: device-plane attribution the runbook's MFU section keys on.
 _CLASSIFY = {
     'gate': 'upstream_victim',      # waiting on someone else's step
     'pull': 'link_or_host',
@@ -79,6 +83,10 @@ _CLASSIFY = {
     'pipeline': 'link_or_host',
     'compute': 'host_compute',
 }
+
+#: roofline regime -> refined compute-phase classification
+_REGIME_CLASSIFY = {'compute': 'compute_bound',
+                    'memory': 'memory_bound'}
 
 
 def _median(vals):
@@ -224,6 +232,12 @@ class CohortMonitor:
         # AND pushes it to the wire) can never double-count
         self._walls = {}     # worker -> OrderedDict[step -> wall]
         self._phases = {}    # worker -> OrderedDict[step -> {phase: s}]
+        # worker -> latest roofline record (regime, mfu, hbm_frac):
+        # fed by observe_roofline (the chief's own tracker) and by
+        # 'roofline' telemetry events riding the span batches (every
+        # other worker's) — refines host_compute verdicts into
+        # compute_bound / memory_bound
+        self._roofline = {}
         self._cursor = {}    # worker -> last consumed batch seq
         self._active = {}    # worker -> live verdict dict
         self._pending = {}   # worker -> consecutive detection count
@@ -258,28 +272,51 @@ class CohortMonitor:
             self.last_step = max(self.last_step, int(step))
 
     def reset_baselines(self):
-        """Drop every rolling window, pending confirmation and active
-        verdict — the batch cursor, link samples, recalibration state
-        and event audit survive. Operators call this after a known
-        disturbance (a replan swap, a membership change, a
-        checkpoint restore) so pre-disturbance samples cannot seed
-        false verdicts against the new steady state."""
+        """Drop every rolling window, pending confirmation, active
+        verdict and per-worker roofline regime — the batch cursor,
+        link samples, recalibration state and event audit survive.
+        Operators call this after a known disturbance (a replan swap,
+        a membership change, a checkpoint restore) so pre-disturbance
+        samples cannot seed false verdicts — or steer a
+        compute/memory-bound refinement with the OLD program's regime
+        — against the new steady state."""
         with self._lock:
             self._walls.clear()
             self._phases.clear()
             self._pending.clear()
             self._active.clear()
+            self._roofline.clear()
+
+    def observe_roofline(self, worker, record):
+        """Record a worker's latest roofline sample
+        (``RooflineTracker.observe_step``'s record): its regime
+        refines that worker's compute-phase straggler verdicts into
+        compute_bound / memory_bound. The chief calls this for its
+        own tracker; remote workers' samples arrive as ``roofline``
+        telemetry events through :meth:`ingest`."""
+        if not record:
+            return
+        with self._lock:
+            self._roofline[worker] = dict(record)
 
     def ingest(self, records):
         """Feed cohort span records (the ``telemetry.aggregate``
         schema): step walls and phase splits enter the rolling windows
-        (warm-up steps excluded), and every data-plane RPC span
-        becomes a link sample for :meth:`recalibrate`."""
+        (warm-up steps excluded), every data-plane RPC span becomes a
+        link sample for :meth:`recalibrate`, and ``roofline`` events
+        update the per-worker device-regime table."""
         if not records:
             return
         splits = phase_splits(records)
         with self._lock:
             self.records_ingested += len(records)
+            for rec in records:
+                if rec.get('name') != 'roofline':
+                    continue
+                tags = rec.get('tags') or {}
+                worker = rec.get('worker') or tags.get('worker')
+                if worker:
+                    self._roofline[worker] = dict(tags)
             for worker, steps in splits.items():
                 walls = self._bounded(self._walls, worker)
                 phases = self._bounded(self._phases, worker)
@@ -558,6 +595,24 @@ class CohortMonitor:
         verdict.update(att)
         if kind == 'wall':
             verdict['classification'] = 'upstream_victim'
+        elif verdict['classification'] == 'host_compute':
+            # device-plane refinement: when the roofline observatory
+            # has a regime for this worker, a compute-phase excess is
+            # attributable to the device roofline (compute_bound /
+            # memory_bound) instead of the host-side catch-all —
+            # which knob acts on it differs (docs/design/roofline.md)
+            roof = self._roofline.get(worker)
+            regime = (roof or {}).get('roofline_regime') or \
+                (roof or {}).get('regime')
+            refined = _REGIME_CLASSIFY.get(regime)
+            if refined:
+                verdict['classification'] = refined
+                verdict['roofline'] = {
+                    'regime': regime,
+                    'mfu': roof.get('mfu'),
+                    'hbm_frac': roof.get('hbm_frac'),
+                    'step': roof.get('step'),
+                }
         verdict['exclude_candidate'] = bool(
             self.policy == 'advise' and
             verdict['classification'] != 'upstream_victim')
@@ -704,6 +759,9 @@ class CohortMonitor:
                                   if e['kind'] == 'recovered'),
                 'recalibrations': [dict(r)
                                    for r in self.recalibrations],
+                'roofline': {w: dict(r)
+                             for w, r in sorted(
+                                 self._roofline.items())},
                 'step_time_s': round(_median(
                     [s['wall_s'] for s in stats.values()]), 6)
                 if stats else 0.0,

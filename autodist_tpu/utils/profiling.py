@@ -179,10 +179,18 @@ def bucket_report(plan, trace_dir=None):
         if rep:
             out['collective_ns'] = rep['by_category'].get('collective', 0)
             out['total_ns'] = rep['total_ns']
+            if stats and not out['collective_ns']:
+                logging.warning(
+                    'profiling: bucket_report joined a trace with ZERO '
+                    'collective time against a plan that emitted %d '
+                    'bucket(s) — the trace did not capture the sync '
+                    'program (empty here is a mismatch, not overlap)',
+                    len(stats))
     return out
 
 
-def collective_timeline(trace_dir, line_name='XLA Ops'):
+def collective_timeline(trace_dir, line_name='XLA Ops',
+                        expected_collectives=0):
     """Per-collective-op durations from a captured trace.
 
     Filters :func:`per_op_breakdown`'s top_ops down to collective-
@@ -191,11 +199,28 @@ def collective_timeline(trace_dir, line_name='XLA Ops'):
     halves): one row per distinct op — with bucketed gradient sync that
     is one row per bucket — as ``[(op text, ns, count)]`` sorted by
     time. The per-bucket latency view of the overlap scheduler.
+
+    ``expected_collectives`` disambiguates the silent-empty path: a
+    run that EMITTED buckets (count known statically from
+    ``strategy.adapter.grad_bucket_layout`` or the plan's
+    ``last_bucket_stats``) whose trace parses to zero collective rows
+    is a parsing/capture mismatch, not a no-collective program — the
+    two used to return identically-empty lists, which made a broken
+    tiered calibration read as a legitimately-flat run (PR 8). With a
+    non-zero expectation the mismatch is logged loudly; 0 keeps the
+    legacy quiet degradation for callers with no static count.
     """
     rep = per_op_breakdown(trace_dir, line_name=line_name)
     if not rep:
         # per_op_breakdown already warned with the specific cause;
         # callers (calibration) degrade on the empty timeline
+        if expected_collectives:
+            logging.warning(
+                'profiling: the plan emitted %d collective(s) but the '
+                'trace in %s yielded NO parseable timeline — this is '
+                'a capture/parsing failure, not a no-collective run; '
+                'calibration will silently keep analytic constants',
+                expected_collectives, trace_dir)
         return []
     rows = []
     for name, ns, cnt in rep['top_ops']:
@@ -204,6 +229,15 @@ def collective_timeline(trace_dir, line_name='XLA Ops'):
                     r'collective-permute|all-to-all)(-start|-done)?',
                     re.sub(r'[.\d]+$', '', base)):
             rows.append((name, ns, cnt))
+    if not rows and expected_collectives:
+        logging.warning(
+            'profiling: the plan emitted %d collective(s) but the '
+            "trace's '%s' timeline (%d ops) parsed to ZERO collective "
+            'rows — a run with collectives whose trace reads as '
+            '"no collectives" (the calibrate/no-op ambiguity that '
+            'broke tiered calibration in PR 8); check the traced line '
+            'name and that the trace covered a synced step',
+            expected_collectives, line_name, len(rep['top_ops']))
     return rows
 
 

@@ -27,20 +27,22 @@ import numpy as np
 BERT_BASELINE_TOKENS_PER_SEC_PER_CHIP = 6900.0
 RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP = 360.0
 
-# Dense bf16 peak FLOP/s per chip by device kind (public spec sheets).
-PEAK_BF16_FLOPS = (
-    ('v6', 918e12),
-    ('v5p', 459e12),
-    ('v5', 197e12),      # v5e / "v5 lite"
-    ('v4', 275e12),
-)
+# Dense bf16 peak FLOP/s per chip by device kind: the per-kind table
+# now lives in resource_spec.PEAKS_BY_KIND (validated into every
+# Topology, shared with the roofline observatory) — this is the
+# headline-MFU view of the same constants.
 
 
 def peak_flops_for(device):
+    from autodist_tpu.resource_spec import (KNOWN_DEVICE_KINDS,
+                                            PEAKS_BY_KIND)
     kind = str(getattr(device, 'device_kind', '')).lower()
-    for key, val in PEAK_BF16_FLOPS:
+    for key in KNOWN_DEVICE_KINDS:
         if key in kind:
-            return val
+            flops = PEAKS_BY_KIND[key][0]
+            if flops:
+                return flops
+            break
     return 197e12        # conservative v5e-class default
 
 
@@ -891,6 +893,222 @@ def _bench_weight_update_inner(steps):
         'devices': n,
     }
     return result
+
+
+def bench_roofline(steps=6):
+    """Device-plane roofline block (ISSUE 15 acceptance).
+
+    One data-parallel train program (8 x [256, 256] f32 vars, matmul
+    chain, Adam-shaped slots, bucketed gradient sync through the real
+    ``plan.sync_gradients``) measured three ways:
+
+    - **MFU / regime**: FLOPs + bytes-accessed from ``cost_analysis()``
+      on the lowered program (cached per compilation), over the median
+      measured step wall and the Topology peak table — explicit
+      ``mfu: null`` + reason on the CPU fallback (no meaningful peak),
+      never a crash;
+    - **HBM drift**: ``memory_analysis()`` argument/temp bytes of the
+      compiled step joined per variable class against
+      ``cost_model.memory_footprint``'s layout-aware estimate (the
+      numbers AutoStrategy's budget pruning trusts);
+    - **per-entry collective drift**: every traced bucket carries its
+      ``static_collective_schedule`` entry id (round-trip asserted in
+      the record); each schedule entry's collective is re-timed ALONE
+      (a microbench leg, ``source: 'microbench'`` — a CPU host has no
+      device timeline to join, and honesty beats an empty column) and
+      joined back through ``telemetry.roofline.drift_table``, whose
+      entry-labeled samples ``calibrate.calibrate_from_drift`` then
+      fits.
+
+    Never raises: any failure degrades to an ``{'error': ...}`` entry
+    so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_roofline_inner(steps)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _bench_roofline_inner(steps, n_vars=8, dim=256, chunk=2):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from autodist_tpu.const import AXIS_DATA
+    from autodist_tpu.frontend import graph as fe
+    from autodist_tpu.parallel.axes import shard_map_compat as _shard_map
+    from autodist_tpu.parallel.plan import ExecutionPlan, \
+        static_collective_schedule
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.simulator.calibrate import calibrate_from_drift
+    from autodist_tpu.simulator.cost_model import (CostModelParams,
+                                                   memory_footprint)
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.strategy.adapter import (FunctionalModel,
+                                               PytreeGraphItem)
+    from autodist_tpu.telemetry import roofline as rl
+
+    devs = probed_devices()
+    n = len(devs)
+    platform = devs[0].platform
+
+    def init_fn(rng):
+        # weights AND biases: two distinct gradient sizes, so the
+        # bucket layout carries two distinct byte classes and the
+        # drift table's entry-labeled α-β refit is non-degenerate
+        # (a single-size schedule cannot separate α from β)
+        out = {'v%02d' % i: jnp.zeros((dim, dim), jnp.float32)
+               for i in range(n_vars)}
+        out.update({'zb%02d' % i: jnp.zeros((dim,), jnp.float32)
+                    for i in range(n_vars)})
+        return out
+
+    gi = PytreeGraphItem(FunctionalModel(init_fn, lambda p, b: 0.0))
+    # the topology names the REAL device kind: on the CPU fallback the
+    # peak table resolves to None and MFU degrades to an explicit null
+    # + reason — a number against a spec the host does not have would
+    # be the folklore this block exists to kill
+    from autodist_tpu.resource_spec import KNOWN_DEVICE_KINDS
+    kind = str(getattr(devs[0], 'device_kind', '') or platform).lower()
+    if not any(k in kind for k in KNOWN_DEVICE_KINDS):
+        kind = platform if any(
+            k in platform for k in KNOWN_DEVICE_KINDS) else ''
+    rs = ResourceSpec(resource_info=dict(
+        {'nodes': [{'address': 'localhost', 'chief': True, 'cpus': [0],
+                    'gpus': list(range(n)),
+                    'network_bandwidth': 100}]},
+        **({'topology': {'device_kind': kind}} if kind else {})))
+    strategy = AllReduce(chunk_size=chunk).build(gi, rs)
+    mesh = Mesh(np.asarray(devs), (AXIS_DATA,))
+    plan = ExecutionPlan(strategy, gi, mesh)
+    sources = list(gi.trainable_var_op_to_var.values())
+    names = [v.name for v in sources]
+    layers = ['v%02d' % i for i in range(n_vars)]
+
+    rng = np.random.RandomState(0)
+    params = {nm: jnp.asarray(
+        (rng.randn(dim, dim) * 0.05).astype('f4'))
+        if nm.startswith('v') else jnp.zeros((dim,), jnp.float32)
+        for nm in names}
+    mu = {nm: jnp.zeros_like(v) for nm, v in params.items()}
+    nu = {nm: jnp.zeros_like(v) for nm, v in params.items()}
+    batch = jnp.asarray(rng.randn(8 * max(n, 1), dim).astype('f4'))
+
+    def step(ps, m1, m2, x):
+        def loss_fn(p):
+            h = x
+            for i, nm in enumerate(layers):
+                h = h @ p[nm] + p['zb%02d' % i]
+            return jnp.mean(h * h)
+
+        loss, grads = jax.value_and_grad(loss_fn)(ps)
+        synced = plan.sync_gradients(sources,
+                                     [grads[nm] for nm in names],
+                                     fe.Env({}, {}))
+        new_p, new_m1, new_m2 = {}, {}, {}
+        for nm, g in zip(names, synced):
+            m = 0.9 * m1[nm] + 0.1 * g
+            v = 0.999 * m2[nm] + 0.001 * g * g
+            new_m1[nm], new_m2[nm] = m, v
+            new_p[nm] = ps[nm] - 1e-3 * m / (jnp.sqrt(v) + 1e-8)
+        return loss, new_p, new_m1, new_m2
+
+    in_specs = (P(), P(), P(), P(AXIS_DATA))
+    out_specs = (P(), P(), P(), P())
+    f = jax.jit(_shard_map(step, mesh, in_specs, out_specs),
+                donate_argnums=(0, 1, 2))
+    lowered = f.lower(params, mu, nu, batch)
+    cost = rl.cost_of(lowered)
+    mem = rl.memory_of(lowered.compile())
+
+    # warmup (compile; records the traced bucket layout) + timed blocks
+    loss, params, mu, nu = f(params, mu, nu, batch)
+    jax.block_until_ready(loss)
+    traced = [dict(e) for e in plan.last_bucket_stats]
+    blocks = []
+    for _ in range(BENCH_REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, mu, nu = f(params, mu, nu, batch)
+        jax.block_until_ready(loss)
+        blocks.append(time.perf_counter() - t0)
+    wall = sorted(blocks)[len(blocks) // 2] / steps
+
+    peak_flops, peak_hbm = rs.topology.peaks()
+    tracker = rl.RooflineTracker(peak_flops=peak_flops,
+                                 peak_hbm_bps=peak_hbm, every=1)
+    for s in range(1, steps + 1):
+        rec = tracker.observe_step(s, wall, cost=cost)
+
+    # per-entry drift: re-time each schedule entry's collective ALONE
+    # and hand the measured rows to the SAME join the trace path uses
+    schedule = static_collective_schedule(strategy, gi, n)
+    timeline = []
+    for i, e in enumerate(schedule):
+        elems = max(1, e['bytes'] // 4)
+        vec = jnp.zeros((elems,), jnp.float32)
+        g = jax.jit(_shard_map(
+            lambda x: jax.lax.psum(x, AXIS_DATA), mesh, (P(),), P()))
+        jax.block_until_ready(g(vec))
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = g(vec)
+        jax.block_until_ready(out)
+        per = (time.perf_counter() - t0) / reps
+        timeline.append((
+            '%%all-reduce.%d = f32[%d]{0} all-reduce(f32[%d]{0} %%p0), '
+            'replica_groups={}' % (i, elems, elems),
+            per * 1e9 * 1, 1))
+    table = rl.drift_table(schedule, timeline, n,
+                           params=CostModelParams())
+    static_ids = {e['entry_id'] for e in schedule}
+    traced_ids = {e.get('entry_id') for e in traced}
+    refit = calibrate_from_drift(CostModelParams(), table, n)
+
+    estimate = memory_footprint(strategy, gi, n, optimizer_slots=2)
+    memory = rl.memory_drift(mem, estimate)
+    if memory.get('drift_ratio') is not None:
+        memory['abs_drift'] = round(abs(memory['drift_ratio'] - 1.0), 4)
+
+    rec = rec or rl.classify_regime(cost.get('flops'),
+                                    cost.get('bytes_accessed'), wall,
+                                    peak_flops, peak_hbm)
+    return {
+        'devices': n,
+        'platform': platform,
+        'per_step_wall_s': round(wall, 6),
+        'flops_per_step': cost.get('flops'),
+        'bytes_accessed_per_step': cost.get('bytes_accessed'),
+        'mfu': rec.get('mfu'),
+        'mfu_null_reason': rec.get('mfu_null_reason'),
+        'hbm_frac': rec.get('hbm_frac'),
+        'roofline_regime': rec.get('roofline_regime'),
+        'peaks': {'flops': peak_flops,
+                  'hbm_bytes_per_s': peak_hbm,
+                  'device_kind': rs.topology.device_kind or platform},
+        'tracker': tracker.snapshot(),
+        'memory': memory,
+        'drift': {
+            'source': 'microbench',
+            'entries': table['entries'],
+            # the entry-labeled samples ride the record so an offline
+            # AutoStrategy(drift_table=<this block>) can refit from it
+            'samples': table['samples'],
+            'tiers': table['tiers'],
+            'worst_drift_ratio': table['worst_drift_ratio'],
+            'matched_rows': table['matched_rows'],
+            'unmatched_rows': table['unmatched_rows'],
+            'entry_ids_roundtrip': traced_ids <= static_ids,
+            'traced_entries': len(traced),
+            'static_entries': len(schedule),
+        },
+        'calibration': {
+            'calibrated': bool(refit.calibrated),
+            'alpha_ici_s': refit.alpha_ici_s,
+            'beta_ici_s_per_byte': refit.beta_ici_s_per_byte,
+        },
+    }
 
 
 def bench_simulator(steps=20):
@@ -2363,6 +2581,7 @@ def main():
         result['extra']['quantized'] = bench_quantized()
         result['extra']['hierarchical'] = bench_hierarchical()
         result['extra']['weight_update'] = bench_weight_update()
+        result['extra']['roofline'] = bench_roofline()
         telemetry_rec = bench_telemetry()
         telemetry_rec['sim_drift'] = _sim_drift(
             result['extra']['simulator'])
@@ -2389,6 +2608,7 @@ def main():
     quantized = bench_quantized()
     hierarchical = bench_hierarchical()
     weight_update = bench_weight_update()
+    roofline = bench_roofline()
     telemetry_rec = bench_telemetry()
     # simulator predicted-vs-measured drift rides the telemetry block:
     # the observe-then-verify loop calibrate.py refits against
@@ -2417,6 +2637,7 @@ def main():
                 'quantized': quantized,
                 'hierarchical': hierarchical,
                 'weight_update': weight_update,
+                'roofline': roofline,
                 'telemetry': telemetry_rec,
                 'monitor': monitor_rec,
                 'analysis': analysis_rec,
@@ -2478,6 +2699,7 @@ def main():
                       'quantized': quantized,
                       'hierarchical': hierarchical,
                       'weight_update': weight_update,
+                      'roofline': roofline,
                       'telemetry': telemetry_rec,
                       'monitor': monitor_rec,
                       'analysis': analysis_rec},

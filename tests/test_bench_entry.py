@@ -102,6 +102,28 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert pred['step_time_s'] > 0 and pred['peak_bytes'] > 0, wu
     assert pred['optimizer_bytes'] < \
         wu['replicated']['opt_slot_bytes_per_device'], wu
+    # ISSUE 15: every record carries the roofline block under its
+    # stable key — MFU explicit-null + reason on the CPU fallback
+    # (never a number against an invented peak), the HBM
+    # measured-vs-estimated drift join, and a per-entry
+    # achieved-vs-predicted drift table whose entry ids round-trip to
+    # the static collective schedule; the entry-labeled samples must
+    # produce a non-degenerate calibration fit
+    ro = extra['roofline']
+    assert 'error' not in ro, ro
+    assert ro['mfu'] is None and ro['mfu_null_reason'], ro
+    assert ro['per_step_wall_s'] > 0
+    assert ro['flops_per_step'] > 0
+    assert ro['memory']['available'] is True, ro['memory']
+    assert ro['memory']['classes']['state']['drift_ratio'] > 0
+    dr = ro['drift']
+    assert dr['entry_ids_roundtrip'] is True, dr
+    assert dr['matched_rows'] >= 1 and dr['unmatched_rows'] == 0, dr
+    assert dr['worst_drift_ratio'] > 0, dr
+    joined = [r for r in dr['entries'] if r['achieved_s'] is not None]
+    assert joined and all(r['predicted_s'] > 0 for r in joined), dr
+    assert ro['calibration']['calibrated'] is True, ro['calibration']
+    assert ro['tracker']['samples'] >= 1, ro['tracker']
     # ISSUE 11: every record carries the telemetry block under its
     # stable key — the on-vs-off overhead A/B, a multi-worker Chrome
     # trace whose step spans align on step ids, a clean conformance

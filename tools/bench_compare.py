@@ -9,7 +9,8 @@ Each BENCH_r*.json is either the driver wrapper (``{'parsed': {...}}``)
 or bench.py's raw output line. The comparison walks a curated metric
 table grouped by the stable record keys (grad_sync, quantized,
 hierarchical, weight_update, elastic, ps_pipeline, telemetry,
-monitor, analysis, top-level throughput) with a per-metric direction; a NEW value worse
+monitor, analysis, roofline, top-level throughput) with a per-metric
+direction; a NEW value worse
 than OLD by
 more than ``--threshold`` (fractional, default 0.10) is a REGRESSION.
 Metrics missing from either record are reported as skipped, never
@@ -83,6 +84,21 @@ METRICS = (
      'lower', 'data-plane model states explored'),
     ('analysis', 'extra.analysis.passes.epoch-swap.states_explored',
      'lower', 'epoch-swap model states explored'),
+    # the device-plane roofline trajectory (ISSUE 15): MFU is the
+    # headline (json-null on the CPU fallback -> skipped; -1 = the
+    # measurement itself failed = failure sentinel, regression);
+    # per-tier achieved bandwidth and the drift ratios gate the cost
+    # model's honesty. The microbench-sourced numbers are noisy
+    # single-host timings, so the drift ratios carry a wide scale.
+    ('roofline', 'extra.roofline.mfu', 'higher', 'per-step MFU'),
+    ('roofline', 'extra.roofline.drift.tiers.ici.achieved_bytes_per_s',
+     'higher', 'ICI achieved bytes/s (per-entry join)', 5),
+    ('roofline', 'extra.roofline.drift.tiers.dcn.achieved_bytes_per_s',
+     'higher', 'DCN achieved bytes/s (per-entry join)', 5),
+    ('roofline', 'extra.roofline.memory.abs_drift', 'lower',
+     'HBM estimate drift |ratio-1|', 5),
+    ('roofline', 'extra.roofline.drift.worst_drift_ratio', 'lower',
+     'worst per-entry collective drift', 5),
 )
 
 
@@ -129,12 +145,14 @@ def compare(old, new, threshold=0.10):
             row['note'] = 'missing in %s record' % (
                 'both' if a is None and b is None
                 else ('old' if a is None else 'new'))
-        elif direction == 'lower' and (a < 0 or b < 0):
-            # a negative lower-is-better value is a FAILURE SENTINEL
-            # (e.g. detection_steps=-1 = the straggler was never
-            # detected) — numerically it would read as the best
-            # possible value and wave the worst possible regression
-            # through the gate
+        elif a < 0 or b < 0:
+            # a negative value is a FAILURE SENTINEL in BOTH
+            # directions (PR 11 rule, extended for the roofline
+            # metrics' null/-1 convention): lower-is-better, -1 would
+            # read as the best possible value (detection_steps=-1 =
+            # never detected); higher-is-better, -1 marks "the
+            # measurement itself failed" distinct from json-null
+            # ("legitimately unavailable", which skips above)
             if b < 0:
                 row['status'] = 'regression'
                 row['note'] = ('failure sentinel in new record '
