@@ -48,7 +48,13 @@ bugs live in, reusing the explorer unchanged via the
 - the **telemetry batch-counter/cursor protocol**: counter bump and
   batch write as separate transitions (the real race window), the
   monitor's incremental cursor with its advance rule as
-  configuration, and a close-time final sweep.
+  configuration, and a close-time final sweep;
+- the **local-SGD window** (sync rounds at ``local_steps`` H > 1):
+  round-scoped gate → pull merged state → H local steps (no wire
+  traffic) → one window-delta push whose merge rule ('average' =
+  workers push delta/W so the PS lands the MEAN of the windows, vs
+  the naive 'sum') and the gate's counter scope (sync ROUNDS vs raw
+  train steps) are the configuration under test.
 
 Invariants:
 
@@ -66,7 +72,13 @@ Invariants:
   prefetch must contain every peer push the gate just guaranteed;
 - **the cursor never permanently skips a decodable batch** (terminal
   invariant: after the final sweep, every batch whose bytes landed
-  was consumed).
+  was consumed);
+- **the H-step staleness bound** — a worker pulling at sync round r
+  observes every peer's window pushes through round r − staleness,
+  so no reader ever sees state older than H × gate_staleness train
+  steps — and **window merges never diverge**: the PS total equals
+  the mean of the pushed windows (the sum-not-average push is the
+  pinned W-fold-overshoot counterexample).
 
 What it deliberately does NOT model: payload values and shapes (the
 chunk stamps track write identity, not bytes — BSADD's index/shape
@@ -121,6 +133,22 @@ class DataPlaneConfig:
     #: mid-run monitor polls in the telemetry scenario (the close-time
     #: final sweep is extra).
     polls: int = 2
+    #: local-SGD window length H in the local_sgd scenario: each
+    #: worker takes H local optimizer steps per sync round, then
+    #: pushes ONE window delta. Kept integer-divisible by the worker
+    #: count so the merged mean is exact integer arithmetic.
+    local_steps: int = 2
+    #: the window merge rule: 'average' (HEAD — the session scales the
+    #: pushed delta by 1/W so the commutative BADD lands the MEAN of
+    #: the workers' windows) vs 'sum' (the naive push: the PS total
+    #: overshoots W-fold, the pinned divergence counterexample).
+    window_merge: str = 'average'
+    #: the staleness gate's counter scope under H > 1: 'rounds' (HEAD
+    #: — gate_at and the published floors both count sync ROUNDS) vs
+    #: 'steps' (the gate target scaled to raw train steps while peers
+    #: still publish rounds — the mixed-scope deadlock the coordinator
+    #: forwards AUTODIST_LOCAL_STEPS to prevent).
+    gate_scope: str = 'rounds'
 
 
 HEAD = DataPlaneConfig()
@@ -136,6 +164,12 @@ UNLOCKED_FENCE_RECHECK = replace(HEAD, fence_recheck='entry_only')
 NO_FLOOR_DISCARD = replace(HEAD, prefetch_guard='serve_always')
 #: ...and the floor read AFTER the pull-ahead it must lower-bound.
 FLOOR_AFTER_PULL = replace(HEAD, floor_scan='after_pull')
+#: The local-SGD window pushed raw (sum of local deltas, no 1/W
+#: scale): every sync round the PS overshoots W-fold.
+LOCAL_SGD_SUM = replace(HEAD, window_merge='sum')
+#: The gate target scaled to train steps while peers publish sync
+#: rounds: every worker blocks at its first gate forever.
+LOCAL_SGD_STEP_GATE = replace(HEAD, gate_scope='steps')
 
 
 # -- tensor-store semantics ----------------------------------------------
@@ -458,6 +492,122 @@ def _pipe_transitions(m, cfg, n, p):
     return [(n, 'run() returns; next step begins', nxt)]
 
 
+# -- local-SGD window ------------------------------------------------------
+
+def _lworker_transitions(m, cfg, n, p):
+    """One loose-mode worker under local-SGD ``H = cfg.local_steps``:
+    round-scoped gate → pull merged state → H local steps (pure
+    device work, no wire traffic) → one window-delta push (the merge
+    rule is the configuration) → publish the sync round. Integer
+    arithmetic throughout: a local step contributes +1 to the window
+    delta, so under 'average' each push lands ``H // W`` on the PS
+    counter and the merged total stays exactly the mean of the
+    workers' windows."""
+    r = p['round']
+    workers = [w for w in sorted(m['procs'])
+               if m['procs'][w]['role'] == 'lworker']
+    peers = [w for w in workers if w != n]
+
+    if p['lphase'] == 'gate':
+        # the staleness gate re-scoped to sync rounds: gate_at = r,
+        # floors are published ROUND counters. The 'steps' scope is
+        # the mixed-scope bug — the target inflates H-fold while the
+        # floors stay in rounds, so no gate ever passes again.
+        target = r - cfg.staleness
+        if cfg.gate_scope == 'steps':
+            target = r * cfg.local_steps - cfg.staleness
+        floors = [m['counters'].get('round/%s' % w, 0) for w in workers]
+        if target <= 0 or min(floors) >= target:
+            def gate(m2, n=n):
+                m2['procs'][n]['lphase'] = 'pull'
+            return [(n, 'round-%d gate passes (floors in sync rounds)'
+                     % r, gate)]
+        return []   # blocked: MINWAIT (liveness catches deadlock)
+
+    if p['lphase'] == 'pull':
+        def pull(m2, n=n):
+            p2 = m2['procs'][n]
+            # the H-step staleness bound: the gate just guaranteed
+            # every peer published round >= r - staleness, and pushes
+            # land BEFORE publishes, so the merged state this pull
+            # observes contains every peer window through that round
+            # — i.e. nothing older than H x gate_staleness steps
+            bound = p2['round'] - cfg.staleness
+            for w in peers:
+                if m2['counters'].get('round/%s' % w, 0) < bound:
+                    _set_violation(
+                        m2, 'stale-window-read',
+                        'worker %s pulled at sync round %d but peer '
+                        '%s had only published round %d (< the bound '
+                        '%d) — the merged state is older than '
+                        'H x gate_staleness train steps'
+                        % (n, p2['round'], w,
+                           m2['counters'].get('round/%s' % w, 0),
+                           bound))
+            p2['lstep'] = 0
+            p2['lphase'] = 'local'
+        return [(n, 'pulls merged state for round %d' % r, pull)]
+
+    if p['lphase'] == 'local':
+        def step(m2, n=n):
+            p2 = m2['procs'][n]
+            p2['lstep'] += 1
+            if p2['lstep'] >= cfg.local_steps:
+                p2['lphase'] = 'push'
+        return [(n, 'local step %d/%d of round %d (no wire traffic)'
+                 % (p['lstep'] + 1, cfg.local_steps, r), step)]
+
+    if p['lphase'] == 'push':
+        def push(m2, n=n):
+            # 'average': the session scales the window delta by 1/W
+            # before the commutative BADD; 'sum' is the naive raw push
+            amt = cfg.local_steps
+            if cfg.window_merge == 'average':
+                amt = cfg.local_steps // len(workers)
+            m2['counters']['ps/T'] = \
+                m2['counters'].get('ps/T', 0) + amt
+            m2['counters']['pushed/%s' % n] = \
+                m2['counters'].get('pushed/%s' % n, 0) + 1
+            m2['procs'][n]['lphase'] = 'publish'
+        return [(n, 'pushes the %s window delta of round %d'
+                 % (cfg.window_merge, r), push)]
+
+    # 'publish': bump the round floor; the last round ends the worker
+    def publish(m2, n=n):
+        p2 = m2['procs'][n]
+        m2['counters']['round/%s' % n] = r
+        if p2['round'] >= cfg.steps:
+            p2['status'] = 'done'
+        else:
+            p2['round'] += 1
+            p2['lphase'] = 'gate'
+    return [(n, 'publishes sync round %d' % r, publish)]
+
+
+def _local_sgd_terminal_check(m):
+    """The window-merge divergence invariant: once every worker is
+    done, the PS total must equal the MEAN of the pushed windows —
+    total_pushes x H / W. The sum-not-average push lands W x that."""
+    workers = sorted(w for w in m['procs']
+                     if m['procs'][w]['role'] == 'lworker')
+    if not workers:
+        return []
+    h = m['procs'][workers[0]]['h']
+    pushes = sum(m['counters'].get('pushed/%s' % w, 0)
+                 for w in workers)
+    expect = pushes * h // len(workers)
+    ps = m['counters'].get('ps/T', 0)
+    if ps != expect:
+        return [(
+            'window-sum-divergence',
+            'after %d window push(es) of H=%d across %d workers the '
+            'PS total is %d, not the window mean %d — the deltas '
+            'were pushed raw (sum) instead of scaled by 1/W, so the '
+            'merged state overshoots W-fold every sync round'
+            % (pushes, h, len(workers), ps, expect))]
+    return []
+
+
 # -- telemetry cursor ------------------------------------------------------
 
 def _tpusher_transitions(m, cfg, n, p):
@@ -546,6 +696,7 @@ _ROLES = {'dwriter': _writer_transitions,
           'dreader': _reader_transitions,
           'fencer': _fencer_transitions,
           'pworker': _pipe_transitions,
+          'lworker': _lworker_transitions,
           'tpusher': _tpusher_transitions,
           'collector': _collector_transitions}
 
@@ -586,6 +737,12 @@ def describe_stuck(m):
             lines.append(
                 'worker %s is blocked at the step-%d gate'
                 % (n, p['step']))
+            continue
+        if p['role'] == 'lworker':
+            lines.append(
+                'worker %s is blocked at the round-%d gate (floors '
+                'are published in sync rounds; a step-scoped gate '
+                'target can never be met)' % (n, p['round']))
             continue
         lines.append('%s is %s (role %s) with no enabled transition'
                      % (n, p['status'], p['role']))
@@ -686,11 +843,27 @@ def telemetry_scenario(cfg):
                      terminal_check=_telemetry_terminal_check)
 
 
+def local_sgd_scenario(cfg):
+    """Two loose-mode workers under local-SGD ``H = cfg.local_steps``
+    training ``cfg.steps`` sync rounds. Proves the H-step staleness
+    bound (no pull observes peer state older than H x gate_staleness
+    train steps) and the window-merge invariant (the PS total is the
+    MEAN of the pushed windows); the sum-not-average push and the
+    step-scoped gate are the pinned counterexamples."""
+    procs = {}
+    for n in ('w0', 'w1'):
+        procs[n] = {'role': 'lworker', 'status': 'running', 'round': 1,
+                    'lphase': 'gate', 'lstep': 0,
+                    'h': cfg.local_steps, 'stall_budget': 0}
+    return _scenario('local_sgd', cfg, _base(procs),
+                     terminal_check=_local_sgd_terminal_check)
+
+
 def scenarios(cfg):
     """The standard data-plane scenario suite for one configuration."""
     return [torn_write_scenario(cfg), writer_death_scenario(cfg),
             zombie_sparse_scenario(cfg), pipeline_scenario(cfg),
-            telemetry_scenario(cfg)]
+            telemetry_scenario(cfg), local_sgd_scenario(cfg)]
 
 
 #: Each seeded pre-fix ordering must yield its counterexample in the
@@ -709,6 +882,10 @@ SEEDED_BUGS = (
      NO_FLOOR_DISCARD, 'pipeline', 'stale-prefetch'),
     ('peer floor scanned after the pull-ahead it must lower-bound',
      FLOOR_AFTER_PULL, 'pipeline', 'stale-prefetch'),
+    ('local-SGD window pushed as SUM not average (W-fold overshoot)',
+     LOCAL_SGD_SUM, 'local_sgd', 'window-sum-divergence'),
+    ('local-SGD gate target scoped to train steps, not sync rounds',
+     LOCAL_SGD_STEP_GATE, 'local_sgd', 'stall'),
 )
 
 #: Exploration statistics of the last :func:`analyze` run.

@@ -460,6 +460,28 @@ class ENV(Enum):
     # same denominator.
     AUTODIST_ROOFLINE_PEAKS = \
         (lambda v: _roofline_peaks('AUTODIST_ROOFLINE_PEAKS', v),)
+    # Local-SGD window length H (runtime/session.py, docs/design/
+    # local-sgd.md): 0 (default) defers to the strategy's per-var
+    # PSSynchronizer.local_steps; >= 1 overrides it globally — workers
+    # take H local optimizer steps between PS sync rounds, pushing the
+    # window's averaged parameter delta once per round. H=1 is today's
+    # every-step loose push, bit-identical. Forwarded to launched
+    # workers (coordinator _FORWARDED_FLAGS): the staleness gate counts
+    # sync ROUNDS under H>1, so every loose worker must agree on the
+    # window length or the gates deadlock against each other.
+    AUTODIST_LOCAL_STEPS = \
+        (lambda v: _min_int('AUTODIST_LOCAL_STEPS', v, 0, lo=0),)
+    # Local-SGD window merge rule: on (default) scales each worker's
+    # window delta by 1/num_workers before the push so the sum-based
+    # PS delta wire lands on the MEAN of the workers' windows ("average"
+    # in the FedAvg sense). '0'/'False' pushes the raw window sum —
+    # the pinned divergence counterexample in analysis/data_plane_model
+    # (W workers overshoot the mean by ~W x); exposed only for A/B and
+    # the model checker, never recommended. Forwarded with
+    # AUTODIST_LOCAL_STEPS: all workers must agree on the merge rule or
+    # the merged state is a mix of scaled and unscaled deltas.
+    AUTODIST_LOCAL_SGD_AVERAGE = \
+        (lambda v: not (v == '0' or v == 'False'),)
 
     @property
     def val(self):

@@ -632,6 +632,10 @@ class VarPlan:
         self.sparse_synced = False   # set at trace time by sync_gradients
         self.staleness = getattr(self.sync, 'staleness', 0)
         self.sync_mode = getattr(self.sync, 'sync', True)
+        # local-SGD window length H (PSSynchronizer.local_steps);
+        # legacy strategies and AR synchronizers carry 1 (every-step)
+        self.local_steps = max(
+            1, int(getattr(self.sync, 'local_steps', 1) or 1))
         if self.is_ar:
             self.compressor = comp.create(self.sync.compressor, var.name)
             self.group = self.sync.group
@@ -796,6 +800,39 @@ class ExecutionPlan:
                 '%d vars; single-program execution is synchronous, which '
                 'is a valid (staleness=0) schedule of the requested bound.',
                 len(relaxed))
+        # local-SGD window length H (docs/design/local-sgd.md): one
+        # step is one program, so per-variable windows collapse to one
+        # program-wide H — mixed requests take the tightest (min),
+        # mirroring the gate's min-staleness collapse above.
+        ps_h = [p.local_steps for p in self.var_plans.values()
+                if p.is_ps]
+        h = min(ps_h) if ps_h else 1
+        if ps_h and len(set(ps_h)) > 1:
+            logging.warning(
+                'Strategy requests mixed local_steps %s across PS vars; '
+                'the step is one program, so the tightest window (%d) '
+                'applies to all of them.', sorted(set(ps_h)), h)
+        env_h = ENV.AUTODIST_LOCAL_STEPS.val
+        if env_h > 0:
+            h = env_h
+        if h > 1 and any(
+                p.is_ps and getattr(p.sync, 'shared_optimizer', False)
+                for p in self.var_plans.values()):
+            logging.warning(
+                'local_steps=%d is incompatible with shared_optimizer '
+                '(the PS-resident update consumes per-step deltas, not '
+                'window-averaged parameter deltas); clamping to 1.', h)
+            h = 1
+        if h > 1 and not loose:
+            # within one SPMD program replicas are lock-step and sync
+            # every step by construction — H>1 only means anything on
+            # the multi-process loose PS data plane
+            logging.warning(
+                'local_steps=%d requested but execution is not loose-'
+                'mode; single-program execution syncs every step '
+                '(H=1 is the only schedule of this program).', h)
+            h = 1
+        self.local_steps = h
 
     def plan_for(self, var):
         name = var if isinstance(var, str) else var.name

@@ -65,6 +65,13 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     # must agree on the pipeline depth and stall window
                     ENV.AUTODIST_PS_PIPELINE_DEPTH,
                     ENV.AUTODIST_PS_STALL_TIMEOUT_S,
+                    # local-SGD window: the staleness gate counts sync
+                    # ROUNDS under H>1, so every loose worker must agree
+                    # on the window length (or the gates deadlock) and
+                    # on the merge rule (or the merged state mixes
+                    # scaled and unscaled deltas)
+                    ENV.AUTODIST_LOCAL_STEPS,
+                    ENV.AUTODIST_LOCAL_SGD_AVERAGE,
                     # elastic recovery: every worker must judge peer
                     # failures under the same policy and bounds
                     ENV.AUTODIST_PEER_FAILURE_POLICY,

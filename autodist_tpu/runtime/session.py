@@ -306,6 +306,7 @@ class Session:
         self._coord = coord
         self._cache = {}
         self._step_count = 0
+        self._round_count = 0   # completed local-SGD sync rounds
         self._closed = False
         self._loose = plan.loose
         # namespace coord-service keys by strategy id: a reused/leaked
@@ -464,6 +465,13 @@ class Session:
                 # the admit handshake already published this floor; the
                 # session resumes counting from it
                 self._step_count = self._admit['adopted_step']
+            # under a local-SGD window the published counters hold sync
+            # ROUNDS, not train steps: a (re)joiner adopts the round
+            # floor and resumes at that round's first train step
+            h = max(1, getattr(plan, 'local_steps', 1))
+            if h > 1 and (self._rejoining or self._joining):
+                self._round_count = self._step_count
+                self._step_count *= h
         # -- online performance sentry (chief-side) --------------------
         # The CohortMonitor streams the cohort's span batches off the
         # telemetry namespace (poll rides the push cadence), issues
@@ -607,7 +615,22 @@ class Session:
         self._tel_push_handle = None
         self._ps_phase = {'pull_s': 0.0, 'push_s': 0.0, 'step_s': 0.0,
                           'exposed_wait_s': 0.0, 'train_steps': 0,
-                          'discarded_prefetches': 0}
+                          'sync_rounds': 0, 'discarded_prefetches': 0}
+        # local-SGD window (docs/design/local-sgd.md): H local optimizer
+        # steps per PS sync round. H=1 (the plan default) is today's
+        # every-step loose push — NONE of the window machinery engages.
+        # Under H>1 the staleness gate, the published counters and the
+        # pipeline floors all count sync ROUNDS, not train steps;
+        # _window_base holds the pulled values the current window's
+        # delta is computed against, and _round_count the completed
+        # rounds. The merge rule (average vs raw sum) is the
+        # AUTODIST_LOCAL_SGD_AVERAGE knob — average scales each
+        # worker's window delta by 1/W so the sum-based delta wire
+        # lands on the mean of the workers' windows. (_round_count is
+        # initialized with _step_count up top: the elastic admit above
+        # may already have adopted a published round floor.)
+        self._local_steps = max(1, getattr(plan, 'local_steps', 1))
+        self._window_base = None
         if self._loose:
             self._init_ps_endpoints()
             depth = ENV.AUTODIST_PS_PIPELINE_DEPTH.val
@@ -1716,15 +1739,25 @@ class Session:
                                 if self._ps_seconds else 0.0),
                    'sparse': dict(self._sparse_stats)}
         steps = max(1, ph['train_steps'])
+        # wire phases happen once per SYNC ROUND: at H=1 rounds ==
+        # train steps (every push is a round) and the divide is the
+        # legacy per-step one bit-for-bit; under a local-SGD window
+        # (H>1) dividing by train steps would understate the per-round
+        # pull/push/exposed averages by H x. step_s stays per train
+        # step — compute happens every step regardless of the window.
+        rounds = max(1, ph['sync_rounds']) if ph['sync_rounds'] \
+            else steps
         wire = ph['pull_s'] + ph['push_s']
         out['pipeline'] = {
             'depth': self._pipeline_depth,
             'train_steps': ph['train_steps'],
+            'sync_rounds': ph['sync_rounds'],
+            'local_steps': self._local_steps,
             'discarded_prefetches': ph['discarded_prefetches'],
-            'pull_s': ph['pull_s'] / steps,
+            'pull_s': ph['pull_s'] / rounds,
             'step_s': ph['step_s'] / steps,
-            'push_s': ph['push_s'] / steps,
-            'exposed_wait_s': ph['exposed_wait_s'] / steps,
+            'push_s': ph['push_s'] / rounds,
+            'exposed_wait_s': ph['exposed_wait_s'] / rounds,
             'overlap_frac': max(0.0, min(1.0, 1.0 -
                                 ph['exposed_wait_s'] / wire))
             if wire > 0 else 0.0,
@@ -2057,27 +2090,46 @@ class Session:
 
         pulled = None
         if self._loose:
-            # join any in-flight background push FIRST (pipeline depth
-            # >= 2): its error surfaces here instead of silently, and
-            # the pull below must observe our own landed pushes
-            # (read-your-writes) — the prefetch record it returns was
-            # only issued after the push completed.
-            prefetch = self._join_pipeline()
+            # local-SGD window position: under H>1 only the first train
+            # step of a window touches the sync plane (join, gate,
+            # pull); the H-1 steps after it run purely locally against
+            # the window base, and fetch-only runs serve local state
+            # (a mid-window pull would clobber the local progress the
+            # window delta is computed from). H=1 takes the every-step
+            # path below unchanged — bit-identical to legacy loose.
+            h = self._local_steps
+            window_start = self._step_count % h == 0
+            sync_run = h == 1 or (is_train and window_start)
+            prefetch = None
+            if sync_run:
+                # join any in-flight background push FIRST (pipeline
+                # depth >= 2): its error surfaces here instead of
+                # silently, and the pull below must observe our own
+                # landed pushes (read-your-writes) — the prefetch
+                # record it returns was only issued after the push
+                # completed.
+                prefetch = self._join_pipeline()
             # bounded-staleness window (reference token queues of size s,
             # ps_synchronizer.py:387-458): before running step s (1-based)
             # every worker must have completed >= s - staleness steps.
-            # sync=False vars are unconditional no-wait (ps_strategy.py:
-            # 30-35); any sync var imposes its (tightest) bound.
+            # Under H>1 the same gate runs once per window over sync
+            # ROUNDS: before round r every worker must have published
+            # >= r - staleness rounds, so no reader ever observes state
+            # older than H * staleness train steps. sync=False vars are
+            # unconditional no-wait (ps_strategy.py:30-35); any sync
+            # var imposes its (tightest) bound.
             self._coord.heartbeat(self._key(self._worker_name))
-            if is_train and self._plan.gate_enabled:
+            if is_train and sync_run and self._plan.gate_enabled:
+                gate_at = self._step_count + 1 if h == 1 \
+                    else self._round_count + 1
                 # membership is a CALLABLE: policy=exclude can shrink
                 # the quorum while we are blocked inside this gate, and
                 # the wait must re-bound against the new epoch's count
                 with self._tel.span('staleness_gate',
-                                    step=self._step_count + 1,
+                                    step=gate_at,
                                     worker=self._worker_name):
                     self._coord.staleness_gate(
-                        self._step_count + 1,
+                        gate_at,
                         self._plan.gate_staleness,
                         self._active_workers,
                         prefix=self._key('step/'),
@@ -2089,10 +2141,15 @@ class Session:
                 # wire time serial mode would have paid anyway)
                 if prefetch is not None and prefetch.get(
                         'peer_floor', -1) < \
-                        self._step_count + 1 - self._plan.gate_staleness:
+                        gate_at - self._plan.gate_staleness:
                     self._account_prefetch_discard(prefetch)
                     prefetch = None
-            pulled = self._pull_ps_vars(prefetch, train=is_train)
+            if sync_run:
+                pulled = self._pull_ps_vars(prefetch, train=is_train)
+                if h > 1:
+                    # the merged state just pulled is the base the
+                    # whole window's delta is computed against
+                    self._window_base = pulled
 
         placed = []
         for v, split in zip(feed_vals, split_flags):
@@ -2161,7 +2218,14 @@ class Session:
                     self._ps_phase['step_s'] += \
                         _time.perf_counter() - t_step
                     self._ps_phase['train_steps'] += 1
-                self._dispatch_push(shared_spec, outs, pulled)
+                if self._local_steps == 1:
+                    self._dispatch_push(shared_spec, outs, pulled)
+                elif self._step_count % self._local_steps == 0:
+                    # window complete: one sync round ships the whole
+                    # window's delta against the base pulled at the
+                    # window's first step
+                    base, self._window_base = self._window_base, None
+                    self._dispatch_push(shared_spec, outs, base)
                 if self._auto_ckpt is not None and \
                         self._step_count % self._auto_ckpt_every == 0:
                     self._auto_checkpoint()
@@ -2249,10 +2313,28 @@ class Session:
         published counter is always current at the gate, and it
         discards a prefetch whose recorded peer floor is below the next
         step's staleness bound — the pipeline adds overlap inside the
-        existing staleness bound, never extra staleness."""
-        step = self._step_count
+        existing staleness bound, never extra staleness.
+
+        Under a local-SGD window (H>1) a dispatch IS a sync round: the
+        published counter, the gate and the pipeline floor all count
+        rounds, and the pushed delta is the whole window's parameter
+        delta against ``pulled`` (the window base), scaled by 1/W when
+        AUTODIST_LOCAL_SGD_AVERAGE is on so the sum-based delta wire
+        lands on the mean of the W workers' windows."""
+        h = self._local_steps
+        scale = None
+        if h > 1:
+            self._round_count += 1
+            step = self._round_count
+            if ENV.AUTODIST_LOCAL_SGD_AVERAGE.val:
+                scale = 1.0 / max(1, len(self._live_members()))
+        else:
+            step = self._step_count
+        tstep = self._step_count
         worker = self._worker_name
         prefix = self._key('step/')
+        with self._stats_lock:
+            self._ps_phase['sync_rounds'] += 1
 
         def shared_values():
             out = {}
@@ -2264,14 +2346,14 @@ class Session:
         if self._pipe is None:
             import time as _time
             t0 = _time.perf_counter()
-            self._push_ps_deltas(pulled, shared_values())
+            self._push_ps_deltas(pulled, shared_values(), scale=scale)
             self._coord.publish_step(worker, step, prefix=prefix)
             self._flight.record('step_publish', worker=worker,
                                 step=step)
             with self._stats_lock:
                 self._ps_phase['exposed_wait_s'] += \
                     _time.perf_counter() - t0
-            self._maybe_push_telemetry(self._coord, step)
+            self._maybe_push_telemetry(self._coord, tstep)
             return
 
         # snapshot the LIVE membership (launch quorum + joins, minus
@@ -2280,11 +2362,11 @@ class Session:
         members = self._live_members()
 
         def job(client):
-            self._push_ps_deltas(pulled, shared_values())
+            self._push_ps_deltas(pulled, shared_values(), scale=scale)
             client.publish_step(worker, step, prefix=prefix)
             self._flight.record('step_publish', worker=worker,
                                 step=step)
-            self._maybe_push_telemetry(client, step)
+            self._maybe_push_telemetry(client, tstep)
             # lower-bound what the pull-ahead below will observe: a
             # peer's published counter only advances AFTER its push
             # landed (push -> publish), so every push published by now
@@ -2513,7 +2595,7 @@ class Session:
             starts.append(starts[-1] + r)
         return starts
 
-    def _push_ps_deltas(self, pulled, shared_push=None):
+    def _push_ps_deltas(self, pulled, shared_push=None, scale=None):
         """Push per-variable updates. Default: ``new - pulled`` deltas —
         the binary BADD is commutative, so concurrent workers' updates
         accumulate exactly like the reference's apply-per-push
@@ -2541,7 +2623,17 @@ class Session:
         new residual — ``compensated - wire_roundtrip(compensated)``,
         bit-exactly the mass the service did not receive — is kept for
         the next push. BADD/BSADD accumulate at f32 rest, so only this
-        push direction quantizes; pulls stay f32."""
+        push direction quantizes; pulls stay f32.
+
+        ``scale`` (local-SGD window averaging, docs/design/local-sgd.md)
+        multiplies every delta before classification and quantization:
+        under H>1 ``pulled`` is the WINDOW base and scale=1/W turns the
+        sum-based wire into the mean of the W workers' window deltas.
+        Scaling before classification keeps the composition exact —
+        the touched-row set is the window's union (a row scaled by 1/W
+        is nonzero iff the raw row is), and the i8 error feedback
+        tracks the scaled wire mass that was actually dropped. None
+        (the H=1 path) is bit-identical to the pre-window plane."""
         import time as _time
 
         from autodist_tpu.runtime import coord_client as cc
@@ -2555,6 +2647,9 @@ class Session:
         deltas = {name: after - np.asarray(pulled[name],
                                            dtype=np.float32)
                   for name, after in afters.items()}
+        if scale is not None and scale != 1.0:
+            deltas = {name: d * np.float32(scale)
+                      for name, d in deltas.items()}
         if lossy:
             for name in list(deltas):
                 res = self._push_residual.get(name)

@@ -133,6 +133,20 @@ class CostModelParams:
     # slots to 1/n, so AutoStrategy's budget pruning unlocks sharded
     # candidates (and thus bigger batches) on tight budgets.
     freed_hbm_s_per_byte: float = 4e-12
+    # Local-SGD divergence haircut (docs/design/local-sgd.md): each
+    # EXTRA local step in an H-step window lets worker copies drift
+    # before the averaged merge, which costs statistical efficiency —
+    # modeled as (H-1) x bytes x this rate added to the per-step cost
+    # of every PS sync entry whose vars ride the window. Calibrated so
+    # the H enumeration flips where it should: on a weak-DCN link the
+    # H-fold wire amortization (~nbytes x beta_dcn x (1-1/H)) dwarfs
+    # the penalty and H in {8,16} wins, while on pure ICI the saved
+    # wire (~nbytes x beta_ici) is SMALLER than one extra step's
+    # penalty and H=1 stays the winner. Divergence is a per-window
+    # statistical cost, not a wall-clock one — pricing it as pseudo-
+    # seconds keeps the ranking one-dimensional, exactly like
+    # freed_hbm_s_per_byte's exchange rate above.
+    local_sgd_divergence_s_per_byte: float = 5e-11
     calibrated: bool = False
 
     @classmethod
@@ -454,6 +468,34 @@ def entry_time(e, n, params, cross_node=False):
     return t, wb
 
 
+def strategy_local_steps(strategy):
+    """The program-wide local-SGD window length H a strategy requests:
+    the min over its PS synchronizers' ``local_steps`` (mirroring
+    ``ExecutionPlan``'s mixed->min collapse — the step is one program,
+    so the tightest window applies), 1 when the strategy has no PS
+    vars. Legacy strategies (no ``local_steps`` attribute) read 1."""
+    hs = []
+    for node in strategy.node_config:
+        syncs = node.part_config if node.part_config \
+            else [node.synchronizer]
+        for s in syncs:
+            if getattr(s, 'kind', '') == 'PS':
+                hs.append(max(1, int(getattr(s, 'local_steps', 1)
+                                     or 1)))
+    return min(hs) if hs else 1
+
+
+def _ps_var_names(strategy):
+    """Names of variables synced through the PS plane (any shard)."""
+    out = set()
+    for node in strategy.node_config:
+        syncs = node.part_config if node.part_config \
+            else [node.synchronizer]
+        if any(getattr(s, 'kind', '') == 'PS' for s in syncs):
+            out.add(node.var_name)
+    return out
+
+
 @dataclass
 class CostReport:
     """Per-strategy prediction: step time, sync decomposition, memory."""
@@ -464,6 +506,9 @@ class CostReport:
     num_collectives: int = 0
     num_replicas: int = 1
     cross_node: bool = False
+    # local-SGD window length the priced strategy syncs at (H): PS wire
+    # terms above are per-STEP averages (the per-round cost / H)
+    local_steps: int = 1
     memory: dict = field(default_factory=dict)
     breakdown: list = field(default_factory=list)
 
@@ -478,6 +523,7 @@ class CostReport:
             'sync_time_s': self.sync_time_s,
             'num_collectives': self.num_collectives,
             'num_replicas': self.num_replicas,
+            'local_steps': self.local_steps,
         }
 
 
@@ -581,6 +627,14 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
                (e['kind'] == 'all_reduce' or
                 (e.get('wus') and e['kind'] == 'psum_scatter'))]
     last_grad_ar = grad_ar[-1] if grad_ar else -1
+    # local-SGD amortization (docs/design/local-sgd.md): PS-synced vars
+    # under an H-step window ship once per H steps, so their per-step
+    # wire price is the per-round cost / H plus the window-averaging
+    # HBM pass (amortized) plus the (H-1)-step divergence haircut.
+    # Only entries wholly made of PS vars amortize — AR buckets in a
+    # mixed (Parallax-style) strategy still sync every step.
+    local_h = strategy_local_steps(strategy)
+    ps_vars = _ps_var_names(strategy) if local_h > 1 else set()
     exposed = 0.0
     for i, e in enumerate(schedule):
         t, wb = entry_time(e, n, params, cross_node=cross_node)
@@ -605,6 +659,16 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
             t_exposed = t * (1.0 - params.ps_overlap_discount)
         else:
             t_exposed = t
+        if local_h > 1 and e['members'] and \
+                all(m in ps_vars for m in e['members']):
+            # per-round wire / H, plus one averaging pass over the
+            # window delta (two HBM touches, amortized over the
+            # window) and the per-extra-step divergence haircut
+            win = e['bytes'] * params.compress_s_per_byte / local_h \
+                + (local_h - 1) * e['bytes'] \
+                * params.local_sgd_divergence_s_per_byte
+            t = t / local_h + win
+            t_exposed = t_exposed / local_h + win
         sync += t
         exposed += t_exposed
         breakdown.append({
@@ -627,6 +691,7 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         num_collectives=len(schedule),
         num_replicas=n,
         cross_node=cross_node,
+        local_steps=local_h,
         memory=mem,
         breakdown=breakdown)
     logging.debug('cost_model.predict: %d collectives, sync=%.3gs '

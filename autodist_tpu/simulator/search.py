@@ -36,11 +36,16 @@ class Candidate:
         return self.report.predicted_peak_bytes if self.report else None
 
 
-def default_candidates(chunk_sizes=(32, 128, 512)):
+def default_candidates(chunk_sizes=(32, 128, 512),
+                       local_steps=(2, 4, 8, 16)):
     """``[(name, builder_factory)]`` covering the nine builders + knobs.
 
     Factories (not instances): several builders carry per-build state
     (PS load maps), so each :func:`rank` call gets fresh ones.
+    ``local_steps`` enumerates local-SGD windows on the PS plane
+    (``PS(H=h)`` candidates; the plain ``PS`` entry is their H=1
+    control) — H-fold wire amortization vs the divergence haircut, so
+    the ranking flips to H>1 exactly where the link is weak enough.
     """
     from autodist_tpu.strategy import builders as b
     cands = []
@@ -85,6 +90,9 @@ def default_candidates(chunk_sizes=(32, 128, 512)):
         ('PartitionedPS', lambda: b.PartitionedPS()),
         ('UnevenPartitionedPS', lambda: b.UnevenPartitionedPS()),
     ]
+    for h in local_steps:
+        cands.append(('PS(H=%d)' % h,
+                      lambda h=h: b.PS(local_steps=h)))
     return cands
 
 
@@ -146,17 +154,18 @@ def rank(graph_item, resource_spec, candidates=None,
 def format_ranked_table(feasible, infeasible=()):
     """Human-readable ranked table (tools/simulate.py output)."""
     rows = []
-    header = ('%-4s %-26s %14s %12s %8s'
+    header = ('%-4s %-26s %14s %12s %8s %4s'
               % ('#', 'candidate', 'pred step (ms)', 'peak (MiB)',
-                 'colls'))
+                 'colls', 'H'))
     rows.append(header)
     rows.append('-' * len(header))
     for c in feasible:
-        rows.append('%-4d %-26s %14.4f %12.1f %8d'
+        rows.append('%-4d %-26s %14.4f %12.1f %8d %4d'
                     % (c.rank, c.name,
                        c.report.predicted_step_time_s * 1e3,
                        c.report.predicted_peak_bytes / (1 << 20),
-                       c.report.num_collectives))
+                       c.report.num_collectives,
+                       getattr(c.report, 'local_steps', 1)))
     for c in infeasible:
         rows.append('---  %-26s pruned: %s' % (c.name, c.error))
     return '\n'.join(rows)

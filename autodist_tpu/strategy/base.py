@@ -56,6 +56,15 @@ class PSSynchronizer:
     # Supported for the SGD family (plain/momentum); other optimizers
     # fall back to worker-local slots with a logged note.
     shared_optimizer: bool = False
+    # local-SGD window length H: workers take H local optimizer steps,
+    # then push the AVERAGED parameter delta accumulated over the window
+    # (delta/num_workers, so the merged PS state lands on the mean of
+    # the workers' windows — a raw sum overshoots by the worker count)
+    # and pull the merged state. 1 (default, and what legacy strategies
+    # deserialize to) is today's every-step loose push, bit-identical.
+    # Only the loose PS data plane honors H>1; shared_optimizer is
+    # incompatible (the PS-resident update consumes per-step deltas).
+    local_steps: int = 1
     kind: str = 'PS'
 
 

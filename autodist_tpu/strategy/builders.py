@@ -56,11 +56,12 @@ class PS(StrategyBuilder):
     """All variables on a single parameter server (the first CPU device)."""
 
     def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
-                 shared_optimizer=False):
+                 shared_optimizer=False, local_steps=1):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
         self._shared_optimizer = shared_optimizer
+        self._local_steps = local_steps
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -74,7 +75,8 @@ class PS(StrategyBuilder):
                     local_replication=self._local_proxy_variable,
                     sync=self._sync,
                     staleness=self._staleness,
-                    shared_optimizer=self._shared_optimizer)))
+                    shared_optimizer=self._shared_optimizer,
+                    local_steps=self._local_steps)))
         return s
 
 
@@ -82,11 +84,12 @@ class PSLoadBalancing(StrategyBuilder):
     """Greedy byte-size bin-packing of variables onto all PS devices."""
 
     def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
-                 shared_optimizer=False):
+                 shared_optimizer=False, local_steps=1):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
         self._shared_optimizer = shared_optimizer
+        self._local_steps = local_steps
         self.loads = {}
 
     def build(self, graph_item, resource_spec):
@@ -107,18 +110,20 @@ class PSLoadBalancing(StrategyBuilder):
                 local_replication=self._local_proxy_variable,
                 sync=self._sync,
                 staleness=self._staleness,
-                shared_optimizer=self._shared_optimizer))
+                shared_optimizer=self._shared_optimizer,
+                local_steps=self._local_steps))
 
 
 class PartitionedPS(StrategyBuilder):
     """Axis-0 partitioning onto load-balanced PSes."""
 
     def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
-                 shared_optimizer=False):
+                 shared_optimizer=False, local_steps=1):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
         self._shared_optimizer = shared_optimizer
+        self._local_steps = local_steps
         self.loads = {}
 
     def build(self, graph_item, resource_spec):
@@ -151,7 +156,8 @@ class PartitionedPS(StrategyBuilder):
                 reduction_destination=dest,
                 local_replication=self._local_proxy_variable,
                 sync=self._sync, staleness=self._staleness,
-                shared_optimizer=self._shared_optimizer)
+                shared_optimizer=self._shared_optimizer,
+                local_steps=self._local_steps)
 
         if num_shards == 1:
             return StrategyNode(var_name=var.name,
@@ -404,7 +410,8 @@ class Parallax(StrategyBuilder):
     def __init__(self, chunk_size=128, local_proxy_variable=False,
                  sync=True, staleness=0, all_reduce_spec='AUTO',
                  compressor='NoneCompressor', shared_optimizer=False,
-                 hierarchical='auto', weight_update_sharding='never'):
+                 hierarchical='auto', weight_update_sharding='never',
+                 local_steps=1):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
@@ -414,6 +421,7 @@ class Parallax(StrategyBuilder):
         self._sync = sync
         self._staleness = staleness
         self._shared_optimizer = shared_optimizer
+        self._local_steps = local_steps
 
     def build(self, graph_item, resource_spec):
         s = Strategy()
@@ -430,7 +438,8 @@ class Parallax(StrategyBuilder):
                         reduction_destination=min_ps,
                         local_replication=self._local_proxy_variable,
                         sync=self._sync, staleness=self._staleness,
-                        shared_optimizer=self._shared_optimizer)))
+                        shared_optimizer=self._shared_optimizer,
+                        local_steps=self._local_steps)))
             else:
                 s.node_config.append(StrategyNode(
                     var_name=var.name,

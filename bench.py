@@ -1333,6 +1333,137 @@ def _bench_ps_pipeline_inner(steps):
     }
 
 
+def bench_local_sgd(steps=15, h=8, delay_s=0.02):
+    """Local-SGD H-step window A/B over a weak link (ISSUE 16
+    acceptance).
+
+    Runs the SAME single-process loose-mode workload (PS strategy,
+    same seed, same feed) at window length H=1 (today's per-step
+    sync) and H=``h`` (one averaged window-delta push per H local
+    steps), with a faultline ``delay_conn`` plan delaying every BADD
+    push frame by ``delay_s`` — the deterministic weak-DCN-link
+    emulation. ``steps`` is chosen so warmup + timed steps is a
+    multiple of ``h``: both legs end on a window boundary and the
+    final states cover the same number of optimizer steps.
+
+    Reports the wire-bytes reduction (H=1 bytes / H=h bytes — the
+    ~H-fold amortization AutoStrategy prices), per-step wall for both
+    legs (the delayed pushes are 1/H as frequent at H=h), the count
+    of delayed pushes each leg actually paid, and the final-state max
+    abs divergence (one worker, so the window delta telescopes to the
+    sequential path — expected float-noise small).
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_local_sgd_inner(steps, h, delay_s)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _local_sgd_run(h, steps, port, delay_s, dim=640):
+    """One fresh single-process loose-mode session at window length
+    ``h`` with the weak-link faultline armed: ``steps`` timed SGD
+    steps after a compile/warmup step. Returns (per-step wall
+    seconds, ps_stats, final W, delayed-push count)."""
+    import time
+
+    import autodist_tpu as ad
+    from autodist_tpu.utils.faultline import FaultLine, FaultPlan
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+
+    # one delay_conn entry per potential push frame (each fires once,
+    # at its k-th matching BADD): the H=1 leg pays one per step, the
+    # H=h leg one per sync round — same plan, same link, fair A/B
+    plan = FaultPlan(
+        [{'kind': 'delay_conn', 'match': 'BADD', 'at': k,
+          'seconds': delay_s}
+         for k in range(1, steps + 4)])
+    with FaultLine(plan, worker='p0') as line:
+        with single_process_loose_env(port, depth=1) \
+                as session_sees_one:
+            autodist = ad.AutoDist(
+                resource_info={'nodes': [
+                    {'address': 'localhost', 'gpus': [0],
+                     'chief': True, 'network_bandwidth': 100}]},
+                strategy_builder=ad.strategy.PS(staleness=2,
+                                                local_steps=h))
+            rng = np.random.RandomState(0)
+            W0 = rng.randn(dim, dim).astype(np.float32)
+            feed = rng.randn(8, dim).astype(np.float32)
+            with autodist.scope():
+                x = ad.placeholder(shape=[None, dim], dtype=np.float32,
+                                   name='x')
+                W = ad.Variable(W0, name='W')
+                loss = ad.ops.reduce_mean(
+                    ad.ops.square(ad.ops.matmul(x, W)))
+                train_op = ad.optimizers.SGD(0.01).minimize(loss, [W])
+                autodist._build()   # sees 2 processes -> loose mode
+                session_sees_one()
+                sess = autodist.create_distributed_session()
+                sess.run(train_op, {x: feed})   # compile + warmup
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    sess.run(train_op, {x: feed})
+                # authoritative read drains the last window push so
+                # both legs pay their final sync inside the window
+                w_final = sess.get_variable_value('W')
+                dt = (time.perf_counter() - t0) / steps
+                stats = sess.ps_stats
+                sess.close()
+        delayed = sum(1 for e in line.events
+                      if e['kind'] == 'delay_conn')
+        return dt, stats, w_final, delayed
+
+
+def _bench_local_sgd_inner(steps, h, delay_s):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    try:
+        d1, stats1, w1, n1 = _local_sgd_run(1, steps, port, delay_s)
+        dh, statsh, wh, nh = _local_sgd_run(h, steps, port, delay_s)
+    finally:
+        # teardown must never clobber measured results: a lingering
+        # service is the launcher's leak to clean, not a bench failure
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    def block(dt, stats, delayed):
+        pipe = stats.get('pipeline', {})
+        return {'per_step_wall_s': round(dt, 5),
+                'wire_bytes': int(stats.get('bytes', 0)),
+                'push_bytes': int(stats.get('push_bytes', 0)),
+                'sync_rounds': int(pipe.get('sync_rounds', 0)),
+                'delayed_pushes': delayed}
+
+    b1 = int(stats1.get('bytes', 0))
+    bh = int(statsh.get('bytes', 0))
+    return {
+        'steps_per_leg': steps,
+        'h': h,
+        'delay_s': delay_s,
+        'h1': block(d1, stats1, n1),
+        'h%d' % h: block(dh, statsh, nh),
+        'wire_bytes_ratio': round(b1 / bh, 2) if bh else 0.0,
+        'wall_speedup': round(d1 / dh, 3) if dh > 0 else 0.0,
+        'divergence': float(np.abs(w1 - wh).max()),
+    }
+
+
 def bench_sparse_ps(steps=10):
     """Row-sparse PS data-plane A/B (ISSUE 5 acceptance).
 
@@ -2575,6 +2706,7 @@ def main():
         result['extra']['grad_sync'] = bench_grad_sync()
         result['extra']['simulator'] = bench_simulator()
         result['extra']['ps_pipeline'] = bench_ps_pipeline()
+        result['extra']['local_sgd'] = bench_local_sgd()
         result['extra']['recovery'] = bench_recovery()
         result['extra']['sparse_ps'] = bench_sparse_ps()
         result['extra']['elastic'] = bench_elastic()
@@ -2602,6 +2734,7 @@ def main():
     grad_sync = bench_grad_sync()
     simulator = bench_simulator()
     ps_pipeline = bench_ps_pipeline()
+    local_sgd = bench_local_sgd()
     recovery = bench_recovery()
     sparse_ps = bench_sparse_ps()
     elastic = bench_elastic()
@@ -2631,6 +2764,7 @@ def main():
                 'grad_sync': grad_sync,
                 'simulator': simulator,
                 'ps_pipeline': ps_pipeline,
+                'local_sgd': local_sgd,
                 'recovery': recovery,
                 'sparse_ps': sparse_ps,
                 'elastic': elastic,
@@ -2693,6 +2827,7 @@ def main():
                       'grad_sync': grad_sync,
                       'simulator': simulator,
                       'ps_pipeline': ps_pipeline,
+                      'local_sgd': local_sgd,
                       'recovery': recovery,
                       'sparse_ps': sparse_ps,
                       'elastic': elastic,

@@ -121,7 +121,7 @@ def test_data_plane_head_explores_clean():
     results = [explore.explore(sc) for sc in dp.scenarios(dp.HEAD)]
     assert {r.scenario for r in results} == {
         'torn_write', 'writer_death', 'zombie_sparse', 'pipeline',
-        'telemetry'}
+        'telemetry', 'local_sgd'}
     for r in results:
         assert r.ok, '\n'.join(explore.format_violation(r, v)
                                for v in r.violations)
@@ -212,6 +212,33 @@ def test_data_plane_extra_seeded_orderings():
         assert 'stale-prefetch' in r.kinds(), (cfg, r.kinds())
 
 
+def test_data_plane_local_sgd_window():
+    """The H-step local-SGD scenario (ISSUE 16): HEAD proves the
+    staleness bound (no pull observes peer state older than
+    H x gate_staleness rounds) and the window-mean invariant across
+    every interleaving; the sum-not-average push re-derives the
+    W-fold overshoot, and a gate target scoped to train steps while
+    peers publish sync rounds deadlocks every worker at its first
+    gate — the mixed-scope bug forwarding AUTODIST_LOCAL_STEPS
+    prevents."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.HEAD, 'local_sgd'))
+    assert r.ok, r.kinds()
+    assert r.terminals > 0
+    r = explore.explore(_dp_scenario(dp.LOCAL_SGD_SUM, 'local_sgd'))
+    assert 'window-sum-divergence' in r.kinds(), r.kinds()
+    v = [v for v in r.violations
+         if v.kind == 'window-sum-divergence'][0]
+    assert 'overshoots W-fold' in v.diagnosis
+    assert any('pushes the sum window delta' in label
+               for _, label in v.trace)
+    r = explore.explore(_dp_scenario(dp.LOCAL_SGD_STEP_GATE,
+                                     'local_sgd'))
+    assert 'stall' in r.kinds(), r.kinds()
+    v = [v for v in r.violations if v.kind == 'stall'][0]
+    assert 'blocked at the round-1 gate' in v.diagnosis
+
+
 def test_data_plane_sensitivity_guard():
     """data_plane_model.analyze() must fail loudly if a seeded bug
     stops re-deriving, exactly like the control-plane checker."""
@@ -224,11 +251,11 @@ def test_data_plane_sensitivity_guard():
         assert any('lost the sensitivity' in f for f in findings)
     finally:
         dp.SEEDED_BUGS = saved
-    # every exploration (5 HEAD scenarios + 6 seeds — two of which
+    # every exploration (6 HEAD scenarios + 8 seeds — two of which
     # share scenario+kind) gets its own stats entry: a blowup in the
     # second pipeline seed must not hide behind the first's count
     dp.analyze()
-    assert len(dp.LAST_STATS['scenarios']) == 11, dp.LAST_STATS
+    assert len(dp.LAST_STATS['scenarios']) == 14, dp.LAST_STATS
     assert dp.LAST_STATS['states_explored'] == sum(
         dp.LAST_STATS['scenarios'].values())
 

@@ -466,7 +466,7 @@ def test_depth2_background_push_error_surfaces(coord, monkeypatch):
         sess.get_variable_value('W')           # drain step 1 cleanly
         real = session_mod.Session._push_ps_deltas
 
-        def boom(self, pulled, shared_push=None):
+        def boom(self, pulled, shared_push=None, scale=1.0):
             raise OSError('injected push failure')
 
         monkeypatch.setattr(session_mod.Session, '_push_ps_deltas',

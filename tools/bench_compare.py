@@ -8,8 +8,9 @@ of the bench trajectory.
 Each BENCH_r*.json is either the driver wrapper (``{'parsed': {...}}``)
 or bench.py's raw output line. The comparison walks a curated metric
 table grouped by the stable record keys (grad_sync, quantized,
-hierarchical, weight_update, elastic, ps_pipeline, telemetry,
-monitor, analysis, roofline, top-level throughput) with a per-metric
+hierarchical, weight_update, elastic, ps_pipeline, local_sgd,
+telemetry, monitor, analysis, roofline, top-level throughput) with a
+per-metric
 direction; a NEW value worse
 than OLD by
 more than ``--threshold`` (fractional, default 0.10) is a REGRESSION.
@@ -58,6 +59,21 @@ METRICS = (
      'PS pipeline depth-2 overlap fraction'),
     ('ps_pipeline', 'extra.ps_pipeline.depth2_speedup', 'higher',
      'PS pipeline depth-2 speedup'),
+    # the local-SGD window trajectory (ISSUE 16): the wire-bytes
+    # ratio is deterministic byte accounting (~H by construction, so
+    # it gates at the normal threshold); the per-step walls are
+    # injected-delay-dominated one-shot timings and the divergence is
+    # float noise around 0 — both carry the wide 5x scale. A
+    # divergence of -1 would be the failure sentinel (legs did not
+    # both finish); the sentinel rule below handles it.
+    ('local_sgd', 'extra.local_sgd.wire_bytes_ratio', 'higher',
+     'local-SGD H=8 wire-bytes reduction'),
+    ('local_sgd', 'extra.local_sgd.wall_speedup', 'higher',
+     'local-SGD H=8 weak-link wall speedup', 5),
+    ('local_sgd', 'extra.local_sgd.h8.per_step_wall_s', 'lower',
+     'local-SGD H=8 per-step wall', 5),
+    ('local_sgd', 'extra.local_sgd.divergence', 'lower',
+     'local-SGD H=8 final-state divergence', 5),
     ('telemetry', 'extra.telemetry.overhead_frac', 'lower',
      'telemetry overhead fraction'),
     ('monitor', 'extra.monitor.detection_steps', 'lower',

@@ -125,6 +125,13 @@ def main(argv=None):
                         '(two-level schedules where the cost model '
                         'picks them) and flat-forced — the per-'
                         'topology A/B the schedules are chosen by')
+    p.add_argument('--local-steps', default='auto',
+                   help='local-SGD window length for the PS(H=...) '
+                        'candidates: "auto" (default) enumerates '
+                        'H in {2, 4, 8, 16} next to the H=1 PS '
+                        'control; an explicit integer restricts the '
+                        'enumeration to that one window (1 = H=1 '
+                        'only, i.e. no PS(H=...) rows)')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -152,17 +159,30 @@ def main(argv=None):
             n or len(replica_devices(rs)),
             cross_node=rs.topology.multi_node)
     budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
+    if args.local_steps == 'auto':
+        local_hs = (2, 4, 8, 16)
+    else:
+        try:
+            h = int(args.local_steps)
+        except ValueError:
+            raise SystemExit('--local-steps must be "auto" or an '
+                             'integer >= 1; got %r' % args.local_steps)
+        if h < 1:
+            raise SystemExit('--local-steps must be >= 1; got %d' % h)
+        # 1 = just the H=1 PS control, no PS(H=...) rows
+        local_hs = () if h == 1 else (h,)
+    candidates = search.default_candidates(local_steps=local_hs)
     feasible, infeasible = search.rank(
-        gi, rs, memory_budget_bytes=budget, params=params,
-        num_replicas=n, optimizer_slots=slots,
+        gi, rs, candidates=candidates, memory_budget_bytes=budget,
+        params=params, num_replicas=n, optimizer_slots=slots,
         sparse_lookups_per_replica=args.sparse_lookups)
     flat = None
     if args.hierarchical:
         # the flat-forced control ranking: nodes=1 prices every bucket
         # as a flat ring regardless of the spec's node structure
         flat = search.rank(
-            gi, rs, memory_budget_bytes=budget, params=params,
-            num_replicas=n, optimizer_slots=slots,
+            gi, rs, candidates=candidates, memory_budget_bytes=budget,
+            params=params, num_replicas=n, optimizer_slots=slots,
             sparse_lookups_per_replica=args.sparse_lookups, nodes=1)
 
     def cand_json(feas, infeas):
