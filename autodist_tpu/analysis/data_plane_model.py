@@ -54,7 +54,14 @@ bugs live in, reusing the explorer unchanged via the
   traffic) → one window-delta push whose merge rule ('average' =
   workers push delta/W so the PS lands the MEAN of the windows, vs
   the naive 'sum') and the gate's counter scope (sync ROUNDS vs raw
-  train steps) are the configuration under test.
+  train steps) are the configuration under test;
+- the **serving snapshot seqlock** (ISSUE 17's reader fleet): the
+  trainer's per-round parity-odd → push tensors → publish → parity-
+  even window (``session._snap_round_open/_close``) against
+  non-voting replicas pulling multi-tensor snapshots, with the
+  replica's ordering (pin parities+step first, pull, revalidate — vs
+  the tempting read-then-stamp) as configuration and the writer
+  crashable mid-round (the parity-stuck-odd keep-old-snapshot trade).
 
 Invariants:
 
@@ -78,7 +85,12 @@ Invariants:
   so no reader ever sees state older than H × gate_staleness train
   steps — and **window merges never diverge**: the PS total equals
   the mean of the pushed windows (the sum-not-average push is the
-  pinned W-fold-overshoot counterexample).
+  pinned W-fold-overshoot counterexample);
+- **no snapshot mixes tensor versions from different published
+  steps** — an ACCEPTED serving snapshot's tensors all carry the one
+  step it is stamped with (a replica losing its writer mid-round
+  gives up and keeps serving its previous snapshot; it never blocks
+  the trainer and never accepts the torn round).
 
 What it deliberately does NOT model: payload values and shapes (the
 chunk stamps track write identity, not bytes — BSADD's index/shape
@@ -149,6 +161,14 @@ class DataPlaneConfig:
     #: still publish rounds — the mixed-scope deadlock the coordinator
     #: forwards AUTODIST_LOCAL_STEPS to prevent).
     gate_scope: str = 'rounds'
+    #: the serving replica's snapshot ordering (ISSUE 17):
+    #: 'pin_then_read' (HEAD — pin the seqlock parities + published
+    #: step FIRST, pull every tensor, revalidate the parities, accept
+    #: iff unchanged) vs 'read_then_pin' (the tempting-but-wrong
+    #: ordering: pull the tensors, THEN read the parity/step and stamp
+    #: the snapshot — a writer completing a whole sync round between
+    #: two tensor reads yields an undetectably mixed snapshot).
+    snapshot_order: str = 'pin_then_read'
 
 
 HEAD = DataPlaneConfig()
@@ -170,6 +190,10 @@ LOCAL_SGD_SUM = replace(HEAD, window_merge='sum')
 #: The gate target scaled to train steps while peers publish sync
 #: rounds: every worker blocks at its first gate forever.
 LOCAL_SGD_STEP_GATE = replace(HEAD, gate_scope='steps')
+#: The serving replica pulling its tensors BEFORE pinning the
+#: parity/step: a writer completing a whole round between two tensor
+#: reads serves an undetectably mixed snapshot.
+SNAPSHOT_READ_BEFORE_PIN = replace(HEAD, snapshot_order='read_then_pin')
 
 
 # -- tensor-store semantics ----------------------------------------------
@@ -608,6 +632,172 @@ def _local_sgd_terminal_check(m):
     return []
 
 
+# -- serving snapshot seqlock (ISSUE 17) -----------------------------------
+
+def _snap_parity(m, writers):
+    """The replica's parity pin: the sum of every trainer's snap
+    counter. Any in-flight round makes it odd; any COMPLETED round
+    changes its value — so 'unchanged across the pull' implies no
+    write activity at all, which is exactly what revalidation needs."""
+    return sum(m['counters'].get('snap/%s' % w, 0) for w in writers)
+
+
+def _snap_floor(m, writers):
+    """The published floor the snapshot is stamped with: min published
+    step across the cohort."""
+    return min(m['counters'].get('sstep/%s' % w, 0) for w in writers)
+
+
+def _swriter_transitions(m, cfg, n, p):
+    """The trainer's publish path as the serving tier sees it
+    (``session._snap_round_open/_close`` around ``_push_ps_deltas`` +
+    ``publish_step``): per sync round the parity counter goes ODD, the
+    dense tensors land one by one, the step publishes, the parity
+    returns EVEN. A crash between any two transitions leaves the
+    parity odd forever — the replica's documented trade is to keep
+    serving its previous snapshot, never to block or to accept."""
+    r = p['round']
+    if p['sphase'] == 'open':
+        def sopen(m2, n=n):
+            m2['counters']['snap/%s' % n] = \
+                m2['counters'].get('snap/%s' % n, 0) + 1
+            m2['procs'][n]['sphase'] = 'pushA'
+        return [(n, 'snap parity goes ODD for round %d' % r, sopen)]
+    if p['sphase'] == 'pushA':
+        def push_a(m2, n=n):
+            m2['kv']['sv/A'] = r
+            m2['procs'][n]['sphase'] = 'pushB'
+        return [(n, 'pushes tensor A at round %d' % r, push_a)]
+    if p['sphase'] == 'pushB':
+        def push_b(m2, n=n):
+            m2['kv']['sv/B'] = r
+            m2['procs'][n]['sphase'] = 'publish'
+        return [(n, 'pushes tensor B at round %d' % r, push_b)]
+    if p['sphase'] == 'publish':
+        def publish(m2, n=n):
+            m2['counters']['sstep/%s' % n] = r
+            m2['procs'][n]['sphase'] = 'close'
+        return [(n, 'publishes step %d' % r, publish)]
+    # 'close': parity returns even; last round ends the trainer
+    def sclose(m2, n=n):
+        p2 = m2['procs'][n]
+        m2['counters']['snap/%s' % n] = \
+            m2['counters'].get('snap/%s' % n, 0) + 1
+        if p2['round'] >= p2['rounds']:
+            p2['status'] = 'done'
+        else:
+            p2['round'] += 1
+            p2['sphase'] = 'open'
+    return [(n, 'snap parity returns EVEN after round %d' % r, sclose)]
+
+
+def _sreader_transitions(m, cfg, n, p):
+    """A non-voting serving replica pulling one multi-tensor snapshot.
+
+    'pin_then_read' (HEAD): pin the parity sum + published floor while
+    even, read tensor A, read tensor B, then REVALIDATE — accept only
+    if the parity sum is unchanged (the monotone counter makes
+    'unchanged' mean 'no write landed'), else retry from the pin. A
+    parity stuck odd with every trainer dead is the crashed-writer
+    case: the replica gives up this pull and keeps its previous
+    snapshot (it must never stall, and must never accept the torn
+    round).
+
+    'read_then_pin' (the seeded tempting-but-wrong ordering): read the
+    tensors FIRST, then read the parity/step once and stamp the
+    snapshot if even — a trainer completing a whole round between the
+    two tensor reads leaves the parity even again, so the mixed
+    snapshot is accepted undetectably."""
+    writers = sorted(w for w in m['procs']
+                     if m['procs'][w]['role'] == 'swriter')
+
+    def writer_live(m2):
+        return any(m2['procs'][w]['status'] in ('running', 'stalled')
+                   for w in writers)
+
+    def accept(m2, n, pinned_step):
+        p2 = m2['procs'][n]
+        if p2['saw_a'] != p2['saw_b'] or p2['saw_a'] != pinned_step:
+            _set_violation(
+                m2, 'mixed-version-snapshot',
+                'replica %s ACCEPTED a snapshot stamped step %d whose '
+                'tensors carry versions A=%d B=%d — tensor versions '
+                'from different published steps served as one '
+                'consistent model' % (n, pinned_step, p2['saw_a'],
+                                      p2['saw_b']))
+        p2['status'] = 'done'
+
+    if cfg.snapshot_order == 'pin_then_read':
+        if p['sphase'] == 'pin':
+            if _snap_parity(m, writers) % 2:
+                if writer_live(m):
+                    return []   # a live trainer will close the round
+                def give_up(m2, n=n):
+                    # crashed-writer-odd-parity: keep the previous
+                    # snapshot, end the pull — never block training,
+                    # never accept the torn round
+                    m2['procs'][n]['status'] = 'done'
+                return [(n, 'gives up the pull (trainer died '
+                         'mid-round, parity stuck odd); keeps serving '
+                         'its previous snapshot', give_up)]
+            def pin(m2, n=n):
+                p2 = m2['procs'][n]
+                p2['pinned_parity'] = _snap_parity(m2, writers)
+                p2['pinned_step'] = _snap_floor(m2, writers)
+                p2['sphase'] = 'readA'
+            return [(n, 'pins parity (even) + published floor', pin)]
+        if p['sphase'] == 'readA':
+            def read_a(m2, n=n):
+                p2 = m2['procs'][n]
+                p2['saw_a'] = m2['kv'].get('sv/A', 0)
+                p2['sphase'] = 'readB'
+            return [(n, 'vmget tensor A', read_a)]
+        if p['sphase'] == 'readB':
+            def read_b(m2, n=n):
+                p2 = m2['procs'][n]
+                p2['saw_b'] = m2['kv'].get('sv/B', 0)
+                p2['sphase'] = 'check'
+            return [(n, 'vmget tensor B', read_b)]
+        # 'check': revalidate the pinned parity
+        def check(m2, n=n):
+            p2 = m2['procs'][n]
+            if _snap_parity(m2, writers) != p2['pinned_parity']:
+                p2['sphase'] = 'pin'   # a write landed: retry
+                return
+            accept(m2, n, p2['pinned_step'])
+        return [(n, 'revalidates the parity; accept iff unchanged',
+                 check)]
+
+    # read_then_pin: tensors first, one parity/step read after
+    if p['sphase'] == 'readA':
+        def read_a(m2, n=n):
+            p2 = m2['procs'][n]
+            p2['saw_a'] = m2['kv'].get('sv/A', 0)
+            p2['sphase'] = 'readB'
+        return [(n, 'vmget tensor A (no pin held)', read_a)]
+    if p['sphase'] == 'readB':
+        def read_b(m2, n=n):
+            p2 = m2['procs'][n]
+            p2['saw_b'] = m2['kv'].get('sv/B', 0)
+            p2['sphase'] = 'pin'
+        return [(n, 'vmget tensor B (no pin held)', read_b)]
+    # 'pin': one parity/step read stamps the snapshot
+    if _snap_parity(m, writers) % 2:
+        if not writer_live(m):
+            def give_up(m2, n=n):
+                m2['procs'][n]['status'] = 'done'
+            return [(n, 'gives up the pull (trainer died mid-round)',
+                     give_up)]
+        def retry(m2, n=n):
+            m2['procs'][n]['sphase'] = 'readA'
+        return [(n, 'parity odd at stamp time: rereads the tensors',
+                 retry)]
+    def stamp(m2, n=n):
+        accept(m2, n, _snap_floor(m2, writers))
+    return [(n, 'parity even at stamp time: accepts the snapshot',
+             stamp)]
+
+
 # -- telemetry cursor ------------------------------------------------------
 
 def _tpusher_transitions(m, cfg, n, p):
@@ -697,6 +887,8 @@ _ROLES = {'dwriter': _writer_transitions,
           'fencer': _fencer_transitions,
           'pworker': _pipe_transitions,
           'lworker': _lworker_transitions,
+          'swriter': _swriter_transitions,
+          'sreader': _sreader_transitions,
           'tpusher': _tpusher_transitions,
           'collector': _collector_transitions}
 
@@ -743,6 +935,12 @@ def describe_stuck(m):
                 'worker %s is blocked at the round-%d gate (floors '
                 'are published in sync rounds; a step-scoped gate '
                 'target can never be met)' % (n, p['round']))
+            continue
+        if p['role'] == 'sreader':
+            lines.append(
+                'serving replica %s is blocked pinning a snapshot: '
+                'the snap parity is stuck odd and no give-up '
+                'transition fired' % n)
             continue
         lines.append('%s is %s (role %s) with no enabled transition'
                      % (n, p['status'], p['role']))
@@ -859,11 +1057,34 @@ def local_sgd_scenario(cfg):
                      terminal_check=_local_sgd_terminal_check)
 
 
+def reader_fleet_scenario(cfg):
+    """One trainer publishing ``cfg.steps`` seqlock-guarded rounds
+    (crashable mid-round — the parity-stuck-odd case) against two
+    non-voting serving replicas each pulling one two-tensor snapshot;
+    replica R0 is itself crashable (a reader killed mid-pull must be
+    harmless). The replica's snapshot ordering is the configuration;
+    the invariant is that no ACCEPTED snapshot mixes tensor versions
+    from different published steps."""
+    procs = {'W': {'role': 'swriter', 'status': 'running', 'round': 1,
+                   'sphase': 'open', 'rounds': cfg.steps,
+                   'stall_budget': 0}}
+    first = ('pin' if cfg.snapshot_order == 'pin_then_read'
+             else 'readA')
+    for n in ('R0', 'R1'):
+        procs[n] = {'role': 'sreader', 'status': 'running',
+                    'sphase': first, 'pinned_parity': -1,
+                    'pinned_step': -1, 'saw_a': -1, 'saw_b': -1,
+                    'stall_budget': 0}
+    return _scenario('reader_fleet', cfg, _base(procs, crash_budget=1),
+                     crashable=('W', 'R0'))
+
+
 def scenarios(cfg):
     """The standard data-plane scenario suite for one configuration."""
     return [torn_write_scenario(cfg), writer_death_scenario(cfg),
             zombie_sparse_scenario(cfg), pipeline_scenario(cfg),
-            telemetry_scenario(cfg), local_sgd_scenario(cfg)]
+            telemetry_scenario(cfg), local_sgd_scenario(cfg),
+            reader_fleet_scenario(cfg)]
 
 
 #: Each seeded pre-fix ordering must yield its counterexample in the
@@ -886,6 +1107,9 @@ SEEDED_BUGS = (
      LOCAL_SGD_SUM, 'local_sgd', 'window-sum-divergence'),
     ('local-SGD gate target scoped to train steps, not sync rounds',
      LOCAL_SGD_STEP_GATE, 'local_sgd', 'stall'),
+    ('snapshot tensors read before the step is pinned (mixed-version '
+     'serve)', SNAPSHOT_READ_BEFORE_PIN, 'reader_fleet',
+     'mixed-version-snapshot'),
 )
 
 #: Exploration statistics of the last :func:`analyze` run.

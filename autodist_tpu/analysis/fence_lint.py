@@ -289,11 +289,46 @@ def check_payload_bounds(text, blocks=None):
     return findings
 
 
+def check_read_only_client(mutating=None):
+    """The read-only-client invariant, machine-checked (ISSUE 17): the
+    serving tier's reader connections refuse every verb this lint
+    classifies as MUTATING, plus FENCE (not a write, but it BINDS a
+    writer generation — a reader holding one would enter the cohort's
+    zombie-detection protocol). The guard lives in coord_client's
+    ``READ_ONLY_BLOCKED``; if a new mutating command lands in the
+    service without a matching entry there, the reader guard silently
+    stops covering the write surface — this check turns that drift
+    into a finding instead of folklore. Returns finding strings."""
+    from autodist_tpu.runtime import coord_client
+    mutating = set(MUTATING if mutating is None else mutating)
+    blocked = set(coord_client.READ_ONLY_BLOCKED)
+    findings = []
+    for cmd in sorted(mutating - blocked):
+        findings.append(
+            'coord_client.py: mutating command %s (%s) is missing from '
+            'READ_ONLY_BLOCKED — a read-only serving connection could '
+            'mutate the training namespace' % (cmd, MUTATING.get(
+                cmd, 'classified mutating by fence_lint')))
+    if 'FENCE' not in blocked:
+        findings.append(
+            'coord_client.py: FENCE is missing from READ_ONLY_BLOCKED '
+            '— a read-only connection could bind a writer generation, '
+            'and readers must never take writer fences')
+    for cmd in sorted(blocked - mutating - {'FENCE'}):
+        findings.append(
+            'coord_client.py: READ_ONLY_BLOCKED lists %s, which '
+            'fence_lint does not classify as mutating (and is not '
+            'FENCE) — stale entry, or a new mutating command missing '
+            'from the MUTATING table' % cmd)
+    return findings
+
+
 def analyze(text=None):
     """Full fence-coverage lint. Returns finding strings (empty =
     clean)."""
     text = _read(text)
     findings = ['coord_service.cc: ' + p for p in find_drift(text)]
+    findings.extend(check_read_only_client())
     blocks = dispatched_blocks(text)
     if not blocks:
         return findings + ['coord_service.cc: could not locate the '

@@ -482,6 +482,45 @@ class ENV(Enum):
     # the merged state is a mix of scaled and unscaled deltas.
     AUTODIST_LOCAL_SGD_AVERAGE = \
         (lambda v: not (v == '0' or v == 'False'),)
+    # Read-only serving tier (serving/, docs/design/serving.md).
+    # Publish-step poll cadence of a ServingReplica: how often the
+    # refresh loop re-reads the cohort's published floor to decide
+    # whether a fresh dense snapshot is worth pulling. Seconds.
+    AUTODIST_SERVE_POLL_S = \
+        (lambda v: _positive_float('AUTODIST_SERVE_POLL_S', v, 0.5),)
+    # Staleness bound a replica ADVERTISES (steps): a served snapshot
+    # whose pinned step trails the current published floor by more than
+    # this counts as a staleness violation in serve_stats — the serving
+    # tier never blocks training to enforce it, it only grades itself.
+    AUTODIST_SERVE_STALENESS_BOUND = \
+        (lambda v: _min_int('AUTODIST_SERVE_STALENESS_BOUND', v, 8,
+                            lo=0),)
+    # Sparse row cache capacity (rows, across all embedding tables a
+    # replica serves). LRU eviction past this.
+    AUTODIST_SERVE_ROW_CACHE_ROWS = \
+        (lambda v: _min_int('AUTODIST_SERVE_ROW_CACHE_ROWS', v, 65536,
+                            lo=1),)
+    # Sparse row cache TTL (seconds): a cached row older than this is
+    # re-fetched on its next lookup — the freshness knob for hot rows
+    # that training keeps pushing (a snapshot version bump flushes the
+    # cache wholesale regardless of TTL).
+    AUTODIST_SERVE_ROW_TTL_S = \
+        (lambda v: _positive_float('AUTODIST_SERVE_ROW_TTL_S', v, 5.0),)
+    # Epoch-consistent snapshot retry budget: how many seqlock rounds
+    # (pin -> pull -> validate) a replica attempts before keeping its
+    # previous snapshot for this poll cycle. Each retry means a writer
+    # raced the pull; the old snapshot stays servable throughout.
+    AUTODIST_SERVE_SNAPSHOT_RETRIES = \
+        (lambda v: _min_int('AUTODIST_SERVE_SNAPSHOT_RETRIES', v, 8,
+                            lo=1),)
+    # Serving pull wire dtype override: '' (default) rides the run's
+    # AUTODIST_PS_WIRE_DTYPE; 'f32' | 'bf16' force a pull dtype for the
+    # replica fleet alone (readers fanning out over DCN may want bf16
+    # snapshots while trainers stay f32); 'i8' is accepted but pulls
+    # ride f32 — the blockscale wire is push-only (quantized-wire.md).
+    AUTODIST_SERVE_WIRE = \
+        (lambda v: _choice('AUTODIST_SERVE_WIRE', v, '',
+                           ('f32', 'bf16', 'i8')),)
 
     @property
     def val(self):

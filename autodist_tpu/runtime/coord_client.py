@@ -79,6 +79,28 @@ class FencedWriteError(OSError):
     supervising coordinator, not to the fenced process."""
 
 
+class ReadOnlyViolation(OSError):
+    """A mutating command was attempted on a read-only connection.
+
+    Raised LOCALLY, before the frame reaches the wire: a read-only
+    client (``CoordClient(read_only=True)`` — the serving tier's data
+    connection) holds the invariant that it can never perturb the
+    training namespace, so the guard must not depend on server-side
+    enforcement or on which keys the command happens to touch."""
+
+
+#: Command verbs a read-only connection refuses locally. The mutating
+#: set mirrors the server's write surface (fence_lint's MUTATING table
+#: machine-checks the correspondence): SET/DEL/DELNS/INCR on the KV
+#: plane, BSET/BADD/BSADD/BSTEP on the tensor plane — plus FENCE,
+#: which is not a write but BINDS a writer generation: a reader taking
+#: a fence would enter the cohort's zombie-detection protocol, and
+#: readers must never hold writer generations.
+READ_ONLY_BLOCKED = frozenset(
+    {'SET', 'DEL', 'DELNS', 'INCR', 'BSET', 'BADD', 'BSADD', 'BSTEP',
+     'FENCE'})
+
+
 # process-wide connection-retry accounting (profiling.health_report):
 # every failed connect attempt inside connect_with_retry counts here.
 RETRY_STATS = {'connect_retries': 0}
@@ -384,7 +406,8 @@ def ps_endpoints():
     return eps
 
 
-def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
+def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0,
+                       read_only=False):
     """Connect to the coord service, retrying until it comes up (workers
     may start before the chief's ensure_service).
 
@@ -402,14 +425,19 @@ def connect_with_retry(address=None, deadline_s=30.0, op_timeout=300.0):
     together does not hammer the service in lockstep; the final
     RuntimeError chains ``from`` the last OSError so the root cause
     (ECONNREFUSED vs EHOSTUNREACH vs auth failure) survives into the
-    traceback."""
+    traceback.
+
+    ``read_only=True`` returns a reader connection (serving tier): no
+    fence binding ever, and every mutating verb raises
+    :class:`ReadOnlyViolation` locally."""
     import random
     deadline = time.time() + deadline_s
     last = None
     delay = 0.05
     while time.time() < deadline:
         try:
-            c = CoordClient(address, timeout=5.0, op_timeout=op_timeout)
+            c = CoordClient(address, timeout=5.0, op_timeout=op_timeout,
+                            read_only=read_only)
             c.ping()
             return c
         except OSError as e:
@@ -451,7 +479,8 @@ class CoordClient:
             return ENV.AUTODIST_PS_STALL_TIMEOUT_S.val
         return self.STALL_TIMEOUT_S
 
-    def __init__(self, address=None, timeout=None, op_timeout=None):
+    def __init__(self, address=None, timeout=None, op_timeout=None,
+                 read_only=False):
         if address is None:
             raw = ENV.AUTODIST_COORD_SERVICE_ADDR.val
             if raw:
@@ -463,6 +492,11 @@ class CoordClient:
         # background heartbeat thread) dial exactly what worked here —
         # the env address may differ (all-local runs rewrite to loopback)
         self.address = address
+        # read-only connections (serving tier) never fence-bind and
+        # refuse every mutating verb locally in _send_frame — the one
+        # choke point both the scalar RPCs and the pipelined batches
+        # pass through, so no command path can bypass the guard
+        self.read_only = bool(read_only)
         # per-RPC telemetry spans (command + payload bytes) when the
         # plane is enabled; one attribute check per RPC when it is not
         self._tel = _telemetry.get()
@@ -527,6 +561,20 @@ class CoordClient:
         ``payload`` may be a LIST of buffers (scatter-gather framing:
         the sparse plane's ``int32 indices || row data`` payloads ship
         without a concat copy of the row bytes)."""
+        if self.read_only:
+            parts = line.split(None, 3)
+            verb = parts[0] if parts else ''
+            # INCR <key> 0 is the plane's counter READ (the server
+            # fence-exempts delta 0 for the same reason); any other
+            # blocked verb dies here, before it can reach the wire
+            if verb in READ_ONLY_BLOCKED and not (
+                    verb == 'INCR' and len(parts) > 2
+                    and parts[2] == '0'):
+                raise ReadOnlyViolation(
+                    '%s refused: this connection is read-only (the '
+                    'serving tier must never mutate the training '
+                    'namespace or bind a writer generation)'
+                    % line.split(None, 1)[0])
         hook = CoordClient.fault_hook
         if hook is not None:
             if isinstance(payload, (list, tuple)):

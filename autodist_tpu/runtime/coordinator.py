@@ -91,6 +91,17 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_TELEMETRY_MAX_SPANS,
                     ENV.AUTODIST_TELEMETRY_PUSH_EVERY,
                     ENV.AUTODIST_FLIGHT_RECORDER_EVENTS,
+                    # serving tier: launched replicas must grade
+                    # staleness against the same bound, poll on the
+                    # same cadence and pull on the same wire as the
+                    # fleet that autoscaled them, or the serve_stats
+                    # the AutoscaleController reads mix regimes
+                    ENV.AUTODIST_SERVE_POLL_S,
+                    ENV.AUTODIST_SERVE_STALENESS_BOUND,
+                    ENV.AUTODIST_SERVE_ROW_CACHE_ROWS,
+                    ENV.AUTODIST_SERVE_ROW_TTL_S,
+                    ENV.AUTODIST_SERVE_SNAPSHOT_RETRIES,
+                    ENV.AUTODIST_SERVE_WIRE,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 

@@ -247,6 +247,48 @@ def admit_worker(coord, ns, max_workers=None, wait_init_s=120.0,
             'epoch': epoch, 'admit_wall_s': wall}
 
 
+def admit_reader(coord, ns, wait_init_s=120.0):
+    """Admit a NON-VOTING serving replica into namespace ``ns`` — the
+    reader half of :func:`admit_worker`, deliberately missing every
+    step that makes a worker count:
+
+    - no fence bind: readers never take writer generations (a
+      read-only data connection cannot even issue FENCE —
+      :class:`~autodist_tpu.runtime.coord_client.ReadOnlyViolation`);
+    - no ``join/world`` claim, no epoch bump, no step publish: the
+      reader must be invisible to :func:`live_members_on_plane`, the
+      staleness gates and every exclusion/quorum path — a reader dying
+      mid-pull must cost the training cohort NOTHING, not even one
+      heartbeat window of exclusion work.
+
+    Readers claim ordinals on their own ``<ns>/serve/world`` counter
+    (same monotone-claim idiom, disjoint key) and heartbeat under
+    ``hb/serve/<ns>/r<i>`` — a SERVE-prefixed liveness plane the
+    training cohort never scans. ``coord`` must be a WRITABLE control
+    connection (the claim and beats are INCRs); the replica's bulk
+    data pulls ride a separate read-only connection.
+
+    Returns ``{'reader_id', 'reader', 'serve_world', 'admit_wall_s'}``.
+    """
+    import time as _time
+    t0 = _time.monotonic()
+    # same legality condition as a worker join: the world/step keys a
+    # reader is about to poll are only guaranteed seeded (and stale
+    # markers cleared) after the cohort's init rendezvous
+    coord.wait_key('%s/session/init-done' % ns, timeout_s=wait_init_s)
+    serve_world = coord.incr('%s/serve/world' % ns, 1)
+    reader_id = serve_world - 1
+    reader = 'r%d' % reader_id
+    coord.heartbeat('serve/%s/%s' % (ns, reader))
+    _telemetry.recorder().record('serve_admit', reader=reader, ns=ns,
+                                 serve_world=serve_world)
+    wall = _time.monotonic() - t0
+    logging.info('admitted serving replica %s into %s (serve world %d, '
+                 'non-voting, %.3fs)', reader, ns, serve_world, wall)
+    return {'reader_id': reader_id, 'reader': reader,
+            'serve_world': serve_world, 'admit_wall_s': wall}
+
+
 class _LazyDefault:
     """Non-data descriptor: a class-level fallback a stub session
     built via ``__new__`` (liveness/chaos tests exercise single
@@ -822,6 +864,26 @@ class Session:
         over."""
         return [i for i in range(self._world)
                 if self._key('p%d' % i) not in self._excluded]
+
+    def _snap_round_open(self, client, worker):
+        """Flip this worker's snapshot-parity counter
+        (``<ns>/snap/<worker>``) to ODD before the sync round's first
+        push frame: the serving tier's epoch-consistent snapshot pull
+        (serving/replica.py) pins all live writers' parities even,
+        pulls, and re-reads — any round open or completed in between
+        invalidates the pull. A stale ODD counter left by a crashed
+        predecessor of this slot (supervised restart) is normalized
+        with a second bump: an open must always END odd or the reader
+        contract inverts for the rest of the run."""
+        if client.incr(self._key('snap/%s' % worker), 1) & 1 == 0:
+            client.incr(self._key('snap/%s' % worker), 1)
+
+    def _snap_round_close(self, client, worker):
+        """EVEN after push + publish: the round's deltas are landed and
+        counted, so a reader pinning now gets a mutually consistent
+        set. Symmetric normalization with :meth:`_snap_round_open`."""
+        if client.incr(self._key('snap/%s' % worker), 1) & 1:
+            client.incr(self._key('snap/%s' % worker), 1)
 
     def _rebuild_hb_peers(self):
         me = ENV.AUTODIST_PROCESS_ID.val
@@ -2346,8 +2408,10 @@ class Session:
         if self._pipe is None:
             import time as _time
             t0 = _time.perf_counter()
+            self._snap_round_open(self._coord, worker)
             self._push_ps_deltas(pulled, shared_values(), scale=scale)
             self._coord.publish_step(worker, step, prefix=prefix)
+            self._snap_round_close(self._coord, worker)
             self._flight.record('step_publish', worker=worker,
                                 step=step)
             with self._stats_lock:
@@ -2362,8 +2426,10 @@ class Session:
         members = self._live_members()
 
         def job(client):
+            self._snap_round_open(client, worker)
             self._push_ps_deltas(pulled, shared_values(), scale=scale)
             client.publish_step(worker, step, prefix=prefix)
+            self._snap_round_close(client, worker)
             self._flight.record('step_publish', worker=worker,
                                 step=step)
             self._maybe_push_telemetry(client, tstep)

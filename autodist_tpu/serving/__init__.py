@@ -1,0 +1,23 @@
+"""Read-only serving tier over the PS data plane (ISSUE 17).
+
+The first consumer of the data plane that is not a trainer: a
+``Session``-less replica fleet that serves lookup+forward queries
+against the LIVE training namespace while the cohort keeps pushing.
+Dense variables refresh as epoch-consistent whole-model snapshots
+pinned to one published step (the seqlock pin -> pull -> revalidate
+protocol in :mod:`~autodist_tpu.serving.replica`); sparse embedding
+tables serve through an LRU+TTL row cache backed by on-demand
+``vmgetrows``. Replicas are NON-VOTING: no fence bind, no step
+publish, no gate participation, invisible to
+``live_members_on_plane`` — a reader's death never stalls training.
+
+See docs/design/serving.md for the consistency contract and the
+staleness model.
+"""
+from autodist_tpu.serving.fleet import (ServingFleet, serve_loop,
+                                        serving_autoscale_policy)
+from autodist_tpu.serving.replica import ServingReplica, SnapshotView
+from autodist_tpu.serving.row_cache import RowCache
+
+__all__ = ['RowCache', 'ServingFleet', 'ServingReplica', 'SnapshotView',
+           'serve_loop', 'serving_autoscale_policy']

@@ -496,6 +496,49 @@ def _ps_var_names(strategy):
     return out
 
 
+def serve_wire_cost(dense_bytes, params=None, replicas=1, poll_hz=2.0,
+                    qps=0.0, rows_per_query=0, row_bytes=0,
+                    row_cache_hit_rate=0.0, compressor=None,
+                    dtype=np.float32):
+    """Serve-side wire model of the read-only replica fleet.
+
+    A serving replica costs the training plane exactly its wire
+    traffic (it holds no fence, votes in no gate): each replica pulls
+    the whole dense model once per accepted poll (``poll_hz``, the
+    ``AUTODIST_SERVE_POLL_S`` cadence upper bound — rejected polls
+    move counters, not tensors) and the fleet's row-cache MISSES
+    (``qps × rows_per_query × (1 − hit_rate)``) fetch embedding rows
+    on demand. Both ride the DCN link class — replicas live outside
+    the pod.
+
+    Returns a dict: ``snapshot_wire_bytes`` (one pull, after the
+    optional wire cast — the bf16/int8 tier halves/quarters the bulk
+    pull exactly like a push), ``snapshot_pull_s`` (α-β time of one
+    pull), ``snapshot_bytes_per_s`` / ``row_bytes_per_s`` /
+    ``serve_bytes_per_s`` (fleet aggregates), and ``dcn_link_frac`` —
+    the fraction of ONE DCN link's bandwidth the fleet consumes, the
+    number an operator sizes ``replicas × poll_hz`` against so serving
+    never eats the training cohort's sync budget.
+    """
+    params = params or CostModelParams()
+    snap_wire = wire_bytes(int(dense_bytes), dtype, compressor)
+    pull_s = params.alpha_dcn_s + snap_wire * params.beta_dcn_s_per_byte
+    snap_rate = float(replicas) * float(poll_hz) * snap_wire
+    miss_rows = float(qps) * float(rows_per_query) \
+        * max(0.0, 1.0 - float(row_cache_hit_rate))
+    row_rate = miss_rows * wire_bytes(int(row_bytes), dtype, compressor)
+    total = snap_rate + row_rate
+    return {
+        'replicas': int(replicas),
+        'snapshot_wire_bytes': snap_wire,
+        'snapshot_pull_s': pull_s,
+        'snapshot_bytes_per_s': snap_rate,
+        'row_bytes_per_s': row_rate,
+        'serve_bytes_per_s': total,
+        'dcn_link_frac': total * params.beta_dcn_s_per_byte,
+    }
+
+
 @dataclass
 class CostReport:
     """Per-strategy prediction: step time, sync decomposition, memory."""

@@ -324,7 +324,8 @@ def format_ps_sparse(report):
                        100.0 * report.get('avoided_frac', 0.0)))
 
 
-def health_report(health_stats, faultline=None, autoscale=None):
+def health_report(health_stats, faultline=None, autoscale=None,
+                  serving=None):
     """Recovery + elasticity observability: one record per run of
     everything the elastic machinery did — so every recovery AND every
     membership change is auditable, not anecdotal.
@@ -342,7 +343,13 @@ def health_report(health_stats, faultline=None, autoscale=None):
     :class:`~autodist_tpu.runtime.coordinator.AutoscaleController` (or
     its ``decisions`` list): decisions taken and skipped ride the
     report. Connection-retry counts come from the process-wide
-    ``coord_client.RETRY_STATS``.
+    ``coord_client.RETRY_STATS``. ``serving`` is a
+    :class:`~autodist_tpu.serving.ServingFleet` (or its
+    :meth:`~autodist_tpu.serving.ServingFleet.stats` dict): the
+    read-only replica fleet's serve stats (QPS, lookup latency
+    percentiles, snapshot staleness, row-cache hit rate, wire bytes)
+    ride the same record — train-while-serve runs audit both planes
+    in one place.
 
     Returns ``{}`` when the session never ran in loose mode (no
     recovery machinery to report on).
@@ -403,6 +410,11 @@ def health_report(health_stats, faultline=None, autoscale=None):
         # trajectory. {} when the chief ran no monitor.
         'perf': dict(hs.get('perf') or {}),
         'auto_checkpoints': hs.get('auto_checkpoints', 0),
+        # read-only serving tier (serving/): {} when no replica fleet
+        # was attached to the run
+        'serving': dict(serving if isinstance(serving, dict)
+                        else (serving.stats() if serving is not None
+                              else {})),
         'connect_retries': RETRY_STATS['connect_retries'],
         'injected_faults': [
             {'kind': e['kind'], 'line': e.get('line', '')}
@@ -463,6 +475,23 @@ def format_health(report):
         lines.append('  autoscale: %d taken / %d skipped / %d failed'
                      % (auto.get('taken', 0), auto.get('skipped', 0),
                         auto.get('failed', 0)))
+    srv = report.get('serving') or {}
+    if srv.get('replicas'):
+        lines.append(
+            '  serving: %d replica(s)  %.0f qps  lookup p50 %.2fms '
+            'p99 %.2fms  staleness %d/%d steps  row-cache hit %.0f%%  '
+            'wire %.1fMB'
+            % (srv.get('replicas', 0), srv.get('qps', 0.0),
+               srv.get('lookup_p50_ms', 0.0),
+               srv.get('lookup_p99_ms', 0.0),
+               srv.get('staleness_steps', 0),
+               srv.get('staleness_bound_steps', 0),
+               100.0 * srv.get('row_cache_hit_rate', 0.0),
+               srv.get('wire_bytes', 0) / 1e6))
+        if srv.get('staleness_violations'):
+            lines.append('    STALENESS VIOLATIONS: %d snapshot(s) '
+                         'served beyond the bound'
+                         % srv['staleness_violations'])
     perf = report.get('perf') or {}
     if perf.get('workers'):
         lines.append(

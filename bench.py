@@ -1464,6 +1464,183 @@ def _bench_local_sgd_inner(steps, h, delay_s):
     }
 
 
+def bench_serving(steps=12, replicas=2):
+    """Train-while-serve A/B (ISSUE 17 acceptance).
+
+    Runs the SAME single-process loose-mode embedding workload (a
+    [vocab, dim] table + dense head, LazyAdam so pushes stay
+    row-sparse) twice: alone, and with a ``replicas``-strong
+    :class:`~autodist_tpu.serving.ServingFleet` polling epoch
+    snapshots and answering row lookups against the live namespace
+    while the trainer runs. Reports the trainer per-step wall for both
+    legs (the slowdown ratio is the headline — readers must be ~free),
+    the fleet's serve stats (QPS, lookup p50/p99, row-cache hit rate,
+    snapshot pulls/retries, wire bytes), and three consistency gates:
+    ``staleness_guard`` (+1 when every accepted snapshot stayed within
+    the staleness bound, the -1 failure sentinel otherwise),
+    ``mixed_version_reads`` (torn snapshots — must be 0), and
+    ``snapshot_divergence`` (final pinned dense snapshot vs the
+    session's authoritative read — bit-exact 0.0 on the f32 wire).
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_serving_inner(steps, replicas)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _serving_run(port, steps, replicas, ids_per_step, vocab, dim):
+    """One fresh loose-mode run; ``replicas`` > 0 adds a concurrent
+    ServingFleet (poll loops + a query-pump thread). Returns (per-step
+    wall s, fleet stats dict or None, final-snapshot max abs
+    divergence vs the authoritative read or None)."""
+    import threading
+    import time
+
+    import autodist_tpu as ad
+    from autodist_tpu.utils.loose_harness import single_process_loose_env
+
+    fleet_stats = None
+    divergence = None
+    with single_process_loose_env(port, depth=1) as sees_one:
+        autodist = ad.AutoDist(
+            resource_info={'nodes': [
+                {'address': 'localhost', 'gpus': [0], 'chief': True,
+                 'network_bandwidth': 100}]},
+            strategy_builder=ad.strategy.PS(
+                staleness=2, local_proxy_variable=True))
+        rng = np.random.RandomState(0)
+        E0 = (rng.randn(vocab, dim) * 0.05).astype(np.float32)
+        W0 = (rng.randn(dim, 1) * 0.05).astype(np.float32)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None], dtype=np.int32,
+                               name='ids')
+            E = ad.Variable(E0, name='E')
+            W = ad.Variable(W0, name='W')
+            emb = ad.ops.embedding_lookup(E, x)
+            loss = ad.ops.reduce_mean(
+                ad.ops.square(ad.ops.matmul(emb, W)))
+            train_op = ad.optimizers.LazyAdam(1e-3).minimize(
+                loss, [E, W])
+            autodist._build()
+            sees_one()
+            sess = autodist.create_distributed_session()
+            sess.run(train_op, {x: ids_per_step[0]})   # compile+warm
+            fleet = None
+            stop = threading.Event()
+            pump = None
+            if replicas:
+                from autodist_tpu.serving import ServingFleet
+                # f32 wire so the final-snapshot divergence gate is
+                # bit-exactness, not quantization error
+                fleet = ServingFleet(
+                    sess._ns, address=('127.0.0.1', port),
+                    dense_vars={'W': (dim, 1)},
+                    sparse_vars={'E': (vocab, dim)},
+                    poll_s=0.02, wire=None)
+                if len(fleet.scale_up(replicas)) != replicas:
+                    raise RuntimeError('serving fleet failed to admit '
+                                       '%d replicas' % replicas)
+                fleet.refresh_all()   # deterministic first snapshot
+                qrng = np.random.RandomState(3)
+                hot = qrng.randint(0, vocab, (64,))   # hot set: hits
+
+                def query_pump():
+                    # steady lookup pressure on caller threads (the
+                    # fleet's poll loops run separately); repeated hot
+                    # rows exercise the cache, the tail misses
+                    while not stop.is_set():
+                        try:
+                            fleet.lookup('E',
+                                         hot[qrng.randint(0, 64, (8,))])
+                        except (OSError, KeyError, RuntimeError):
+                            pass   # replica mid-close; pump retries
+                        stop.wait(0.001)
+                pump = threading.Thread(target=query_pump, daemon=True)
+                pump.start()
+            t0 = time.perf_counter()
+            for ids in ids_per_step[1:]:
+                sess.run(train_op, {x: ids})
+            dt = (time.perf_counter() - t0) / max(
+                1, len(ids_per_step) - 1)
+            if fleet is not None:
+                stop.set()
+                pump.join(timeout=10)
+                fleet.refresh_all()   # pin the final published step
+                w_auth = sess.get_variable_value('W')
+                snaps = [r.snapshot.values['W'] for r in fleet.replicas
+                         if r.snapshot is not None]
+                divergence = max(
+                    float(np.abs(s - w_auth).max()) for s in snaps) \
+                    if len(snaps) == replicas else -1.0
+                fleet_stats = fleet.stats()
+                fleet.stop()
+            sess.close()
+    return dt, fleet_stats, divergence
+
+
+def _bench_serving_inner(steps, replicas):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    vocab, dim, batch = 8192, 64, 256
+    rng = np.random.RandomState(7)
+    # the SAME id sequence drives both legs: identical trainer math,
+    # so the wall-clock delta is the serving tier's cost alone
+    ids_per_step = [rng.randint(0, vocab, (batch,), dtype=np.int32)
+                    for _ in range(steps + 1)]
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    try:
+        dt_alone, _, _ = _serving_run(
+            port, steps, 0, ids_per_step, vocab, dim)
+        dt_serve, fs, divergence = _serving_run(
+            port, steps, replicas, ids_per_step, vocab, dim)
+    finally:
+        # teardown must never clobber measured results: a lingering
+        # service is the launcher's leak to clean, not a bench failure
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+
+    return {
+        'steps_per_leg': steps,
+        'replicas': replicas,
+        'vocab': vocab, 'dim': dim,
+        'alone': {'per_step_wall_s': round(dt_alone, 5)},
+        'serving': {
+            'per_step_wall_s': round(dt_serve, 5),
+            'qps': round(fs['qps'], 1),
+            'lookups': fs['lookups'],
+            'lookup_p50_ms': round(fs['lookup_p50_ms'], 3),
+            'lookup_p99_ms': round(fs['lookup_p99_ms'], 3),
+            'row_cache_hit_rate': round(fs['row_cache_hit_rate'], 3),
+            'staleness_max_steps': fs['staleness_max_steps'],
+            'staleness_bound_steps': fs['staleness_bound_steps'],
+            'snapshot_pulls': fs['snapshot_pulls'],
+            'snapshot_retries': fs['snapshot_retries'],
+            'wire_bytes': fs['wire_bytes'],
+        },
+        # readers must be ~free: the ratio is the headline A/B number
+        'trainer_slowdown': round(dt_serve / dt_alone, 3)
+        if dt_alone > 0 else 0.0,
+        'staleness_guard': -1.0 if fs['staleness_violations'] else 1.0,
+        'mixed_version_reads': fs['mixed_version_reads'],
+        'snapshot_divergence': divergence,
+    }
+
+
 def bench_sparse_ps(steps=10):
     """Row-sparse PS data-plane A/B (ISSUE 5 acceptance).
 
@@ -2707,6 +2884,7 @@ def main():
         result['extra']['simulator'] = bench_simulator()
         result['extra']['ps_pipeline'] = bench_ps_pipeline()
         result['extra']['local_sgd'] = bench_local_sgd()
+        result['extra']['serving'] = bench_serving()
         result['extra']['recovery'] = bench_recovery()
         result['extra']['sparse_ps'] = bench_sparse_ps()
         result['extra']['elastic'] = bench_elastic()
@@ -2735,6 +2913,7 @@ def main():
     simulator = bench_simulator()
     ps_pipeline = bench_ps_pipeline()
     local_sgd = bench_local_sgd()
+    serving = bench_serving()
     recovery = bench_recovery()
     sparse_ps = bench_sparse_ps()
     elastic = bench_elastic()
@@ -2765,6 +2944,7 @@ def main():
                 'simulator': simulator,
                 'ps_pipeline': ps_pipeline,
                 'local_sgd': local_sgd,
+                'serving': serving,
                 'recovery': recovery,
                 'sparse_ps': sparse_ps,
                 'elastic': elastic,
@@ -2828,6 +3008,7 @@ def main():
                       'simulator': simulator,
                       'ps_pipeline': ps_pipeline,
                       'local_sgd': local_sgd,
+                      'serving': serving,
                       'recovery': recovery,
                       'sparse_ps': sparse_ps,
                       'elastic': elastic,

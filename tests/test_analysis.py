@@ -121,7 +121,7 @@ def test_data_plane_head_explores_clean():
     results = [explore.explore(sc) for sc in dp.scenarios(dp.HEAD)]
     assert {r.scenario for r in results} == {
         'torn_write', 'writer_death', 'zombie_sparse', 'pipeline',
-        'telemetry', 'local_sgd'}
+        'telemetry', 'local_sgd', 'reader_fleet'}
     for r in results:
         assert r.ok, '\n'.join(explore.format_violation(r, v)
                                for v in r.violations)
@@ -251,11 +251,11 @@ def test_data_plane_sensitivity_guard():
         assert any('lost the sensitivity' in f for f in findings)
     finally:
         dp.SEEDED_BUGS = saved
-    # every exploration (6 HEAD scenarios + 8 seeds — two of which
+    # every exploration (7 HEAD scenarios + 9 seeds — two of which
     # share scenario+kind) gets its own stats entry: a blowup in the
     # second pipeline seed must not hide behind the first's count
     dp.analyze()
-    assert len(dp.LAST_STATS['scenarios']) == 14, dp.LAST_STATS
+    assert len(dp.LAST_STATS['scenarios']) == 16, dp.LAST_STATS
     assert dp.LAST_STATS['states_explored'] == sum(
         dp.LAST_STATS['scenarios'].values())
 

@@ -59,6 +59,26 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert el['admit_wall_s'] > 0
         assert el['state_max_abs_diff'] == 0.0
         assert el['replans']
+    # ISSUE 17: every record carries the train-while-serve A/B under
+    # its stable key — the replica fleet really served during training
+    # (snapshots pulled, lookups answered) and every consistency gate
+    # held: staleness within bound (guard +1, not the -1 sentinel),
+    # zero torn mixed-version reads, and the final pinned snapshot
+    # bit-exact against the session's authoritative read (f32 wire)
+    sv = extra['serving']
+    if shutil.which('g++'):
+        assert 'error' not in sv, sv
+        assert sv['replicas'] == 2, sv
+        assert sv['alone']['per_step_wall_s'] > 0, sv
+        assert sv['serving']['per_step_wall_s'] > 0, sv
+        assert sv['serving']['snapshot_pulls'] >= 1, sv
+        assert sv['serving']['lookups'] >= 1, sv
+        assert sv['serving']['staleness_max_steps'] <= \
+            sv['serving']['staleness_bound_steps'], sv
+        assert sv['staleness_guard'] == 1.0, sv
+        assert sv['mixed_version_reads'] == 0, sv
+        assert sv['snapshot_divergence'] == 0.0, sv
+        assert sv['trainer_slowdown'] > 0, sv
     # ISSUE 8: every record carries the quantized A/B under its stable
     # key — wire bytes measured >= 3x smaller on both data planes,
     # divergence bounded and reported

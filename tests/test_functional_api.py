@@ -54,6 +54,34 @@ needs_partial_manual = pytest.mark.skipif(
     not supports_partial_manual(), reason=OLD_JAX_REASON)
 
 
+def test_partial_manual_gates_are_evaluated():
+    """Carry-over guard: every ``supports_partial_manual``-gated skip
+    in tests/ CALLS the probe. A bare function reference inside a
+    skipif is always truthy, so one dropped ``()`` silently flips a
+    whole gate to skip-always (or, under ``not``, run-always on jax
+    that cannot lower) — and the probe itself must stay pinned to the
+    one capability it documents."""
+    import ast
+    import pathlib
+    from autodist_tpu.parallel import axes
+    assert axes.supports_partial_manual() == hasattr(jax, 'shard_map')
+    offenders = []
+    for path in sorted(pathlib.Path(__file__).parent.glob('**/*.py')):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        call_funcs = {id(node.func) for node in ast.walk(tree)
+                      if isinstance(node, ast.Call)}
+        for node in ast.walk(tree):
+            ref = (isinstance(node, ast.Name)
+                   and node.id == 'supports_partial_manual') or \
+                  (isinstance(node, ast.Attribute)
+                   and node.attr == 'supports_partial_manual')
+            if ref and id(node) not in call_funcs:
+                offenders.append('%s:%d' % (path.name, node.lineno))
+    assert not offenders, (
+        'supports_partial_manual referenced without being CALLED '
+        '(gates must evaluate the probe): %s' % offenders)
+
+
 @pytest.fixture(scope='module')
 def dp_losses(tiny_lm, batch):
     return run_losses(tiny_lm, ParallelSpec(), batch)

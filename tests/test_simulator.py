@@ -363,3 +363,44 @@ def test_simulate_cli_table_and_budget():
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert out.returncode == 0, out.stderr[-2000:]
     assert 'pruned' in out.stdout
+
+
+# -- serving-tier wire model (ISSUE 17) -----------------------------------
+
+def test_serve_wire_cost_scales_and_casts():
+    """The fleet's DCN draw scales linearly in replicas x poll rate,
+    row traffic prices only the MISSES, and the int8 wire shrinks the
+    bulk pull ~4x (blockscale header included)."""
+    from autodist_tpu.simulator.cost_model import serve_wire_cost
+    dense = 100 << 20
+    one = serve_wire_cost(dense, replicas=1, poll_hz=2.0)
+    four = serve_wire_cost(dense, replicas=4, poll_hz=2.0)
+    assert four['snapshot_bytes_per_s'] == pytest.approx(
+        4 * one['snapshot_bytes_per_s'])
+    assert one['snapshot_wire_bytes'] == dense          # f32: raw
+    assert one['dcn_link_frac'] > 0
+    # misses drive row traffic: a perfect cache costs zero row bytes
+    hot = serve_wire_cost(dense, qps=100.0, rows_per_query=64,
+                          row_bytes=256, row_cache_hit_rate=1.0)
+    cold = serve_wire_cost(dense, qps=100.0, rows_per_query=64,
+                           row_bytes=256, row_cache_hit_rate=0.0)
+    assert hot['row_bytes_per_s'] == 0.0
+    assert cold['row_bytes_per_s'] == pytest.approx(100 * 64 * 256)
+    # the int8 tier shrinks the pull ~4x, never below 1/4 + header
+    i8 = serve_wire_cost(dense, compressor='Int8RingCompressor')
+    assert dense / 4 <= i8['snapshot_wire_bytes'] < dense / 3.8
+
+
+def test_simulate_cli_serving_block():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'simulate.py'),
+         '--model', 'tinylm', '--json', '--serve-replicas', '2',
+         '--serve-qps', '100', '--serve-wire', 'bf16'],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    srv = rec['serving']
+    assert srv['replicas'] == 2 and srv['wire'] == 'bf16'
+    assert 0 < srv['dcn_link_frac'] < 1
+    assert srv['serve_bytes_per_s'] >= srv['snapshot_bytes_per_s']

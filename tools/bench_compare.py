@@ -74,6 +74,27 @@ METRICS = (
      'local-SGD H=8 per-step wall', 5),
     ('local_sgd', 'extra.local_sgd.divergence', 'lower',
      'local-SGD H=8 final-state divergence', 5),
+    # the train-while-serve trajectory (ISSUE 17): the slowdown ratio
+    # and lookup latencies are one-shot concurrent-thread timings
+    # (scheduler-noise dominated), so they carry the wide 5x scale.
+    # The three consistency gates are deterministic: staleness_guard
+    # is +1/-1 (-1 = a replica accepted a snapshot past its staleness
+    # bound — the failure-sentinel rule fires), mixed_version_reads
+    # counts torn snapshots (must stay 0; the zero-baseline epsilon
+    # catches the first one appearing), and snapshot_divergence is
+    # bit-exactness of the final pinned snapshot on the f32 wire.
+    ('serving', 'extra.serving.trainer_slowdown', 'lower',
+     'train-while-serve trainer slowdown ratio', 5),
+    ('serving', 'extra.serving.serving.lookup_p99_ms', 'lower',
+     'serving lookup p99 latency', 5),
+    ('serving', 'extra.serving.serving.qps', 'higher',
+     'serving fleet lookup throughput', 5),
+    ('serving', 'extra.serving.staleness_guard', 'higher',
+     'serving staleness-bound guard (-1 = bound violated)'),
+    ('serving', 'extra.serving.mixed_version_reads', 'lower',
+     'serving torn-snapshot reads'),
+    ('serving', 'extra.serving.snapshot_divergence', 'lower',
+     'serving final-snapshot divergence vs authoritative read'),
     ('telemetry', 'extra.telemetry.overhead_frac', 'lower',
      'telemetry overhead fraction'),
     ('monitor', 'extra.monitor.detection_steps', 'lower',

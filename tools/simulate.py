@@ -132,6 +132,30 @@ def main(argv=None):
                         'control; an explicit integer restricts the '
                         'enumeration to that one window (1 = H=1 '
                         'only, i.e. no PS(H=...) rows)')
+    p.add_argument('--serve-replicas', type=int, default=0,
+                   help='price a read-only serving fleet of this many '
+                        'replicas next to the ranking (0 = off): each '
+                        'replica pulls the dense model over DCN at '
+                        '--serve-poll-hz and row-cache misses fetch '
+                        'embedding rows on demand')
+    p.add_argument('--serve-poll-hz', type=float, default=2.0,
+                   help='snapshot poll cadence per replica (the '
+                        '1/AUTODIST_SERVE_POLL_S upper bound; only '
+                        'accepted polls move tensor bytes)')
+    p.add_argument('--serve-qps', type=float, default=0.0,
+                   help='fleet-aggregate lookup queries per second')
+    p.add_argument('--serve-rows-per-query', type=int, default=256,
+                   help='embedding rows touched per lookup query')
+    p.add_argument('--serve-row-bytes', type=int, default=256,
+                   help='bytes per embedding row (f32 cols x 4)')
+    p.add_argument('--serve-row-cache-hit', type=float, default=0.8,
+                   help='expected row-cache hit rate in [0, 1] '
+                        '(AUTODIST_SERVE_ROW_CACHE_ROWS / '
+                        'AUTODIST_SERVE_ROW_TTL_S sizing)')
+    p.add_argument('--serve-wire', default='f32',
+                   choices=('f32', 'bf16', 'i8'),
+                   help='wire dtype of the bulk snapshot pull '
+                        '(AUTODIST_SERVE_WIRE)')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -185,6 +209,25 @@ def main(argv=None):
             params=params, num_replicas=n, optimizer_slots=slots,
             sparse_lookups_per_replica=args.sparse_lookups, nodes=1)
 
+    serving = None
+    if args.serve_replicas > 0:
+        from autodist_tpu.simulator.cost_model import serve_wire_cost
+        import numpy as np
+        dense_bytes = sum(
+            int(np.prod(v.shape or (1,)))
+            * np.dtype(v.dtype).itemsize
+            for v in gi.trainable_var_op_to_var.values())
+        wire_comp = {'f32': None, 'bf16': 'HorovodCompressor',
+                     'i8': 'Int8RingCompressor'}[args.serve_wire]
+        serving = serve_wire_cost(
+            dense_bytes, params=params, replicas=args.serve_replicas,
+            poll_hz=args.serve_poll_hz, qps=args.serve_qps,
+            rows_per_query=args.serve_rows_per_query,
+            row_bytes=args.serve_row_bytes,
+            row_cache_hit_rate=args.serve_row_cache_hit,
+            compressor=wire_comp)
+        serving['wire'] = args.serve_wire
+
     def cand_json(feas, infeas):
         return [dict(c.strategy.cost, feasible=True) for c in feas] + \
             [{'builder': c.name, 'feasible': False, 'error': c.error}
@@ -199,6 +242,8 @@ def main(argv=None):
         }
         if flat is not None:
             out['candidates_flat'] = cand_json(*flat)
+        if serving is not None:
+            out['serving'] = serving
         print(json.dumps(out))
         return 0
     print('model=%s  vars=%d  %r  replicas=%d%s' % (
@@ -212,6 +257,17 @@ def main(argv=None):
     if flat is not None:
         print('-- flat-forced ranking (every bucket a flat ring) --')
         print(search.format_ranked_table(*flat))
+    if serving is not None:
+        print('serving: %d replica(s) @ %.1f polls/s on the %s wire  '
+              'snapshot %.2fMB/pull (%.1fms)  fleet %.2fMB/s '
+              '(rows %.2fMB/s)  = %.1f%% of one DCN link'
+              % (serving['replicas'], args.serve_poll_hz,
+                 serving['wire'],
+                 serving['snapshot_wire_bytes'] / 1e6,
+                 1e3 * serving['snapshot_pull_s'],
+                 serving['serve_bytes_per_s'] / 1e6,
+                 serving['row_bytes_per_s'] / 1e6,
+                 100.0 * serving['dcn_link_frac']))
     return 0
 
 
