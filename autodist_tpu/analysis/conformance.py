@@ -249,10 +249,16 @@ def check_events(events):
 
 def check_dump(path):
     """Load a flight-recorder dump and check it; returns
-    ``(findings, meta)``."""
+    ``(findings, meta)``. Delegates the ``swap_*`` event kinds to
+    :mod:`~autodist_tpu.analysis.swap_conformance` so one dump replay
+    covers both the control-plane protocol and the epoch-swap
+    handshake."""
+    from autodist_tpu.analysis import swap_conformance
     from autodist_tpu.telemetry.flight import load_dump
     events, meta = load_dump(path)
-    return check_events(events), meta
+    findings = check_events(events)
+    findings.extend(swap_conformance.check_swap_events(events))
+    return findings, meta
 
 
 def analyze(paths):

@@ -169,6 +169,16 @@ class DataPlaneConfig:
     #: the snapshot — a writer completing a whole sync round between
     #: two tensor reads yields an undetectably mixed snapshot).
     snapshot_order: str = 'pin_then_read'
+    #: the trainer's snap-parity behavior across an epoch-swap re-key
+    #: (PR 19): 'bump' (HEAD — ``session._execute_replan`` brackets
+    #: the re-key in ``_snap_round_open/_close``, so a replica pull
+    #: straddling the swap boundary can never revalidate its pinned
+    #: parity) vs 'silent' (re-key the tensors without touching the
+    #: parity — a replica that pinned before the swap and read across
+    #: it revalidates an UNCHANGED parity and accepts a snapshot
+    #: mixing pre- and post-swap shard layouts: with overlapping key
+    #: names of different geometry, merged garbage).
+    swap_parity: str = 'bump'
 
 
 HEAD = DataPlaneConfig()
@@ -194,6 +204,10 @@ LOCAL_SGD_STEP_GATE = replace(HEAD, gate_scope='steps')
 #: parity/step: a writer completing a whole round between two tensor
 #: reads serves an undetectably mixed snapshot.
 SNAPSHOT_READ_BEFORE_PIN = replace(HEAD, snapshot_order='read_then_pin')
+#: The epoch-swap re-key applied WITHOUT the snap-parity bracket: a
+#: replica pull straddling the swap boundary revalidates clean and
+#: serves a snapshot mixing the two shard layouts.
+SWAP_SILENT_REKEY = replace(HEAD, swap_parity='silent')
 
 
 # -- tensor-store semantics ----------------------------------------------
@@ -678,17 +692,60 @@ def _swriter_transitions(m, cfg, n, p):
             m2['counters']['sstep/%s' % n] = r
             m2['procs'][n]['sphase'] = 'close'
         return [(n, 'publishes step %d' % r, publish)]
-    # 'close': parity returns even; last round ends the trainer
-    def sclose(m2, n=n):
-        p2 = m2['procs'][n]
+    if p['sphase'] == 'close':
+        # parity returns even; the last round either ends the trainer
+        # or hands off to a pending epoch-swap re-key
+        def sclose(m2, n=n):
+            p2 = m2['procs'][n]
+            m2['counters']['snap/%s' % n] = \
+                m2['counters'].get('snap/%s' % n, 0) + 1
+            if p2['round'] >= p2['rounds']:
+                if p2.get('swap_pending'):
+                    p2['sphase'] = 'swapopen' \
+                        if cfg.swap_parity == 'bump' else 'rekeyA'
+                else:
+                    p2['status'] = 'done'
+            else:
+                p2['round'] += 1
+                p2['sphase'] = 'open'
+        return [(n, 'snap parity returns EVEN after round %d' % r,
+                 sclose)]
+
+    # -- epoch-swap re-key (PR 19): session._execute_replan moving the
+    # authoritative PS values old-keys -> new-keys. Values are moved,
+    # never recomputed (sv/* unchanged); what changes is the shard
+    # LAYOUT (lay/*). HEAD brackets the re-key in the same snap-parity
+    # open/close the push path uses, so a straddling replica pull can
+    # never revalidate; the 'silent' configuration re-keys without it.
+    if p['sphase'] == 'swapopen':
+        def swopen(m2, n=n):
+            m2['counters']['snap/%s' % n] = \
+                m2['counters'].get('snap/%s' % n, 0) + 1
+            m2['procs'][n]['sphase'] = 'rekeyA'
+        return [(n, 'snap parity goes ODD for the epoch-swap re-key',
+                 swopen)]
+    if p['sphase'] == 'rekeyA':
+        def rekey_a(m2, n=n):
+            m2['kv']['lay/A'] = 2
+            m2['procs'][n]['sphase'] = 'rekeyB'
+        return [(n, 're-keys tensor A under the new plan (layout 2)',
+                 rekey_a)]
+    if p['sphase'] == 'rekeyB':
+        def rekey_b(m2, n=n):
+            p2 = m2['procs'][n]
+            m2['kv']['lay/B'] = 2
+            if cfg.swap_parity == 'bump':
+                p2['sphase'] = 'swapclose'
+            else:
+                p2['status'] = 'done'
+        return [(n, 're-keys tensor B under the new plan (layout 2)',
+                 rekey_b)]
+    # 'swapclose': parity returns even, the swap is committed
+    def swclose(m2, n=n):
         m2['counters']['snap/%s' % n] = \
             m2['counters'].get('snap/%s' % n, 0) + 1
-        if p2['round'] >= p2['rounds']:
-            p2['status'] = 'done'
-        else:
-            p2['round'] += 1
-            p2['sphase'] = 'open'
-    return [(n, 'snap parity returns EVEN after round %d' % r, sclose)]
+        m2['procs'][n]['status'] = 'done'
+    return [(n, 'snap parity returns EVEN after the re-key', swclose)]
 
 
 def _sreader_transitions(m, cfg, n, p):
@@ -717,7 +774,16 @@ def _sreader_transitions(m, cfg, n, p):
 
     def accept(m2, n, pinned_step):
         p2 = m2['procs'][n]
-        if p2['saw_a'] != p2['saw_b'] or p2['saw_a'] != pinned_step:
+        if p2.get('lay_a', 1) != p2.get('lay_b', 1):
+            _set_violation(
+                m2, 'swap-torn-snapshot',
+                'replica %s ACCEPTED a snapshot straddling the '
+                'epoch-swap re-key: tensor A carries shard layout %d, '
+                'tensor B layout %d — with overlapping key names of '
+                'different geometry the merged value is garbage, and '
+                'the parity revalidation never fired'
+                % (n, p2.get('lay_a', 1), p2.get('lay_b', 1)))
+        elif p2['saw_a'] != p2['saw_b'] or p2['saw_a'] != pinned_step:
             _set_violation(
                 m2, 'mixed-version-snapshot',
                 'replica %s ACCEPTED a snapshot stamped step %d whose '
@@ -750,12 +816,14 @@ def _sreader_transitions(m, cfg, n, p):
             def read_a(m2, n=n):
                 p2 = m2['procs'][n]
                 p2['saw_a'] = m2['kv'].get('sv/A', 0)
+                p2['lay_a'] = m2['kv'].get('lay/A', 1)
                 p2['sphase'] = 'readB'
             return [(n, 'vmget tensor A', read_a)]
         if p['sphase'] == 'readB':
             def read_b(m2, n=n):
                 p2 = m2['procs'][n]
                 p2['saw_b'] = m2['kv'].get('sv/B', 0)
+                p2['lay_b'] = m2['kv'].get('lay/B', 1)
                 p2['sphase'] = 'check'
             return [(n, 'vmget tensor B', read_b)]
         # 'check': revalidate the pinned parity
@@ -773,12 +841,14 @@ def _sreader_transitions(m, cfg, n, p):
         def read_a(m2, n=n):
             p2 = m2['procs'][n]
             p2['saw_a'] = m2['kv'].get('sv/A', 0)
+            p2['lay_a'] = m2['kv'].get('lay/A', 1)
             p2['sphase'] = 'readB'
         return [(n, 'vmget tensor A (no pin held)', read_a)]
     if p['sphase'] == 'readB':
         def read_b(m2, n=n):
             p2 = m2['procs'][n]
             p2['saw_b'] = m2['kv'].get('sv/B', 0)
+            p2['lay_b'] = m2['kv'].get('lay/B', 1)
             p2['sphase'] = 'pin'
         return [(n, 'vmget tensor B (no pin held)', read_b)]
     # 'pin': one parity/step read stamps the snapshot
@@ -1079,12 +1149,41 @@ def reader_fleet_scenario(cfg):
                      crashable=('W', 'R0'))
 
 
+def reader_fleet_swap_scenario(cfg):
+    """The reader fleet across an epoch-swap boundary (PR 19): one
+    trainer publishes a seqlock-guarded round and then APPLIES an
+    armed epoch swap — re-keying both tensors under the new plan
+    (values moved, layouts changed) — while two serving replicas pull
+    snapshots; the trainer may crash mid-swap. ``cfg.swap_parity`` is
+    the configuration under test: HEAD's open/close bracket around the
+    re-key forces any straddling pull to fail revalidation (or give up
+    on a mid-swap death), while the silent re-key lets a replica
+    accept a snapshot mixing the two shard layouts. One reader: the
+    mixed-layout property is local to a single replica's
+    pin -> read -> revalidate cycle (multi-reader independence is
+    reader_fleet's job), and the second reader only multiplies the
+    interleaving product without new orderings."""
+    procs = {'W': {'role': 'swriter', 'status': 'running', 'round': 1,
+                   'sphase': 'open', 'rounds': 1, 'swap_pending': True,
+                   'stall_budget': 0}}
+    first = ('pin' if cfg.snapshot_order == 'pin_then_read'
+             else 'readA')
+    for n in ('R0',):
+        procs[n] = {'role': 'sreader', 'status': 'running',
+                    'sphase': first, 'pinned_parity': -1,
+                    'pinned_step': -1, 'saw_a': -1, 'saw_b': -1,
+                    'stall_budget': 0}
+    return _scenario('reader_fleet_swap', cfg,
+                     _base(procs, crash_budget=1), crashable=('W',))
+
+
 def scenarios(cfg):
     """The standard data-plane scenario suite for one configuration."""
     return [torn_write_scenario(cfg), writer_death_scenario(cfg),
             zombie_sparse_scenario(cfg), pipeline_scenario(cfg),
             telemetry_scenario(cfg), local_sgd_scenario(cfg),
-            reader_fleet_scenario(cfg)]
+            reader_fleet_scenario(cfg),
+            reader_fleet_swap_scenario(cfg)]
 
 
 #: Each seeded pre-fix ordering must yield its counterexample in the
@@ -1110,6 +1209,9 @@ SEEDED_BUGS = (
     ('snapshot tensors read before the step is pinned (mixed-version '
      'serve)', SNAPSHOT_READ_BEFORE_PIN, 'reader_fleet',
      'mixed-version-snapshot'),
+    ('epoch-swap re-key without the snap-parity bracket (straddling '
+     'replica accepts mixed shard layouts)', SWAP_SILENT_REKEY,
+     'reader_fleet_swap', 'swap-torn-snapshot'),
 )
 
 #: Exploration statistics of the last :func:`analyze` run.

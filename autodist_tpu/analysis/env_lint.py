@@ -75,9 +75,6 @@ FORWARD_EXEMPT = {
         'cohort',
     'AUTODIST_AUTO_CHECKPOINT_EVERY':
         'chief-side checkpoint backstop; workers never act on it',
-    'AUTODIST_EXECUTE_REPLAN':
-        'chief-side migration opt-in (cohort-wide propagation is '
-        'ROADMAP 3a)',
     'AUTODIST_FAULT_PLAN':
         'chaos-only: honored only where a FaultLine is explicitly '
         'installed; production sessions never read it',

@@ -85,6 +85,46 @@ ALLOWED_UNFENCED = {
 #: appear in the dispatcher.
 HANDSHAKE_ONLY = {'AUTH'}
 
+#: The epoch-swap handshake's key schema (runtime/swap_keys.py) and the
+#: protocol verbs each key rides, with the fencing rationale. The swap
+#: handshake introduces NO new protocol commands — every write rides a
+#: verb the MUTATING table already fences, which is exactly the
+#: property :func:`check_swap_keys` proves: a zombie chief (superseded
+#: fence generation) cannot stage, cancel, or arm a swap, because SET/
+#: INCR/DELNS all reject it. Key templates use ``<g>`` for the staged
+#: generation and ``<w>`` for a worker ordinal.
+SWAP_KEY_VERBS = {
+    'swap/gen': 'INCR — monotone generation counter; the stage bump '
+                'is fenced, discovery reads are delta-0',
+    'swap/<g>/plan': 'SET/GET/DELNS — staged plan payload; staging '
+                     'and cancel are fenced writes',
+    'swap/<g>/ack/<w>': 'SET/GET/DELNS — peer validation ack '
+                        '(fenced: a zombie peer cannot fill a quorum)',
+    'swap/<g>/nack/<w>': 'SET/GET/DELNS — peer rejection + reason '
+                         '(fenced: a zombie cannot cancel a live '
+                         'swap)',
+    'swap/<g>/B': 'SET/GET/DELNS — the armed commit boundary; arming '
+                  'is a fenced write',
+    'swap/<g>/ready': 'SET/GET/DELNS — chief finished re-keying the '
+                      'authoritative PS copies (GET via wait_key '
+                      'polling)',
+}
+
+#: DELNS prefixes in swap_keys.py — namespace sweeps, not keys; they
+#: cover whole generations (cancel / previous-generation purge) or the
+#: whole subtree (run-end purge).
+SWAP_KEY_PREFIXES = {'swap/', 'swap/<g>/'}
+
+#: coord_client methods the swap-key module may call, mapped to the
+#: protocol verb each one speaks (wait_key is a GET poll loop).
+_SWAP_CLIENT_VERBS = {
+    'set': 'SET',
+    'get': 'GET',
+    'incr': 'INCR',
+    'delete_namespace': 'DELNS',
+    'wait_key': 'GET',
+}
+
 #: Commands whose header line declares a size. 'request' = the
 #: declared payload bytes are buffered before handle() runs, so the
 #: bound must live in ``payload_size()`` (return ``kBadPayload`` past
@@ -323,12 +363,100 @@ def check_read_only_client(mutating=None):
     return findings
 
 
+def _swap_keys_source():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'runtime', 'swap_keys.py')
+    with open(path) as f:
+        return f.read()
+
+
+def _normalize_swap_template(lit):
+    """A ``swap/...`` string literal from swap_keys.py in the table's
+    ``<g>``/``<w>`` template form: the first ``%d`` is the generation,
+    a second is a worker ordinal."""
+    out = lit.replace('%d', '<g>', 1)
+    return out.replace('%d', '<w>', 1)
+
+
+def check_swap_keys(src=None):
+    """The epoch-swap key-schema classification (PR 19): statically
+    parse ``runtime/swap_keys.py`` and prove (a) every coordinator
+    verb it speaks is classified (MUTATING or ALLOWED_UNFENCED — its
+    writes all ride fenced verbs, so a zombie chief cannot stage,
+    cancel, or arm a swap), and (b) every ``swap/*`` key template it
+    builds has a :data:`SWAP_KEY_VERBS` entry (and vice versa) — a new
+    swap key or verb forces an explicit fencing decision here instead
+    of drifting in silently. Returns finding strings."""
+    import ast
+    src = _swap_keys_source() if src is None else src
+    tree = ast.parse(src)
+    findings = []
+    methods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == 'client':
+            methods.add(node.func.attr)
+    for m in sorted(methods - set(_SWAP_CLIENT_VERBS)):
+        findings.append(
+            'swap_keys.py: calls coord-client method %s, which '
+            'fence_lint does not map to a protocol verb '
+            '(_SWAP_CLIENT_VERBS) — a new verb in the swap handshake '
+            'needs an explicit fencing decision' % m)
+    for m in sorted(methods & set(_SWAP_CLIENT_VERBS)):
+        verb = _SWAP_CLIENT_VERBS[m]
+        if verb not in MUTATING and verb not in ALLOWED_UNFENCED:
+            findings.append(
+                'swap_keys.py: speaks verb %s (via client.%s) which '
+                'is classified in neither MUTATING nor '
+                'ALLOWED_UNFENCED — the swap handshake must ride '
+                'classified verbs only' % (verb, m))
+    # the values of swap_keys.MODEL_SYMBOLS are ABSTRACT model-side
+    # symbols (epoch_swap_model vocabulary), not coordinator keys —
+    # collect them so the literal sweep below skips them
+    abstract = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == 'MODEL_SYMBOLS'
+                for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    abstract.add(v.value)
+    lits = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith('swap/') and \
+                node.value not in abstract:
+            lits.add(_normalize_swap_template(node.value))
+    keys = lits - SWAP_KEY_PREFIXES
+    for k in sorted(keys - set(SWAP_KEY_VERBS)):
+        findings.append(
+            'swap_keys.py: builds swap key %s with no '
+            'SWAP_KEY_VERBS classification in analysis/fence_lint.py '
+            '— a new swap/<gen> key needs an explicit fencing '
+            'decision' % k)
+    for k in sorted(set(SWAP_KEY_VERBS) - keys):
+        findings.append(
+            'fence_lint.py: SWAP_KEY_VERBS classifies %s, which '
+            'swap_keys.py no longer builds — stale table entry' % k)
+    for p in sorted(SWAP_KEY_PREFIXES - lits):
+        findings.append(
+            'fence_lint.py: SWAP_KEY_PREFIXES lists %s, which '
+            'swap_keys.py no longer uses — stale prefix entry' % p)
+    return findings
+
+
 def analyze(text=None):
     """Full fence-coverage lint. Returns finding strings (empty =
     clean)."""
     text = _read(text)
     findings = ['coord_service.cc: ' + p for p in find_drift(text)]
     findings.extend(check_read_only_client())
+    findings.extend(check_swap_keys())
     blocks = dispatched_blocks(text)
     if not blocks:
         return findings + ['coord_service.cc: could not locate the '

@@ -352,6 +352,23 @@ class ENV(Enum):
     # the PR 6 predicted-vs-kept audit trail is unchanged unless the
     # operator opts in.
     AUTODIST_EXECUTE_REPLAN = (lambda v: (v == 'True' or v == '1'),)
+    # Epoch-swap handshake bounds (runtime/swap_keys.py, docs/design/
+    # epoch-swap.md): how long the chief waits for the peer ack quorum
+    # on a staged plan before cancelling the stage, how long it backs
+    # off before re-staging, and how many cancel-and-retry rounds it
+    # attempts before degrading to an audit-only re-plan entry.
+    # Forwarded to launched workers (coordinator _FORWARDED_FLAGS):
+    # peers bound their ready-marker wait with the same ack timeout,
+    # and a cohort split on the bound would strand slow members at the
+    # swap boundary.
+    AUTODIST_SWAP_ACK_TIMEOUT_S = \
+        (lambda v: _positive_float('AUTODIST_SWAP_ACK_TIMEOUT_S', v,
+                                   60.0),)
+    AUTODIST_SWAP_RETRY_BACKOFF_S = \
+        (lambda v: _positive_float('AUTODIST_SWAP_RETRY_BACKOFF_S', v,
+                                   5.0),)
+    AUTODIST_SWAP_MAX_RETRIES = \
+        (lambda v: _min_int('AUTODIST_SWAP_MAX_RETRIES', v, 3, lo=0),)
     # opt-in DenseNet dense-block form: preallocated buffer +
     # dynamic-update-slice instead of per-layer concat (O(L) vs O(L^2)
     # copy traffic; exactness tested, on-chip A/B pending — see

@@ -102,6 +102,15 @@ _FORWARDED_FLAGS = (ENV.AUTODIST_MIN_LOG_LEVEL, ENV.AUTODIST_IS_TESTING,
                     ENV.AUTODIST_SERVE_ROW_TTL_S,
                     ENV.AUTODIST_SERVE_SNAPSHOT_RETRIES,
                     ENV.AUTODIST_SERVE_WIRE,
+                    # epoch-swap handshake: the replan opt-in and the
+                    # handshake bounds are cohort-wide — every member
+                    # must validate/ack staged plans and apply at the
+                    # armed boundary, and peers bound their ready-
+                    # marker wait with the same ack timeout
+                    ENV.AUTODIST_EXECUTE_REPLAN,
+                    ENV.AUTODIST_SWAP_ACK_TIMEOUT_S,
+                    ENV.AUTODIST_SWAP_RETRY_BACKOFF_S,
+                    ENV.AUTODIST_SWAP_MAX_RETRIES,
                     ENV.SYS_DATA_PATH, ENV.SYS_RESOURCE_PATH)
 
 

@@ -484,6 +484,13 @@ class Session:
                 # per-worker batch counter would hand the collector
                 # sequence numbers that decode to the dead run's spans
                 self._coord.delete_namespace(self._key('telemetry/'))
+                # likewise any staged epoch-swap plan (generation
+                # counter included): a crashed prior run's staged
+                # generation must never be validated/acked — let alone
+                # applied — by THIS run's cohort (the armed boundary
+                # would compare against the dead run's step floors)
+                from autodist_tpu.runtime import swap_keys
+                swap_keys.purge_all(self._coord, self._ns)
                 # seed the elastic world counter to the launch quorum
                 # BEFORE the init rendezvous (admits wait for the
                 # init-done marker, so no join can race this). A stale
@@ -644,6 +651,15 @@ class Session:
         # is half old-layout, half new).
         self._replan_lock = threading.Lock()
         self._pending_replan = None
+        # epoch-swap handshake (runtime/swap_keys.py, docs/design/
+        # epoch-swap.md): _pending_swap holds the staged generation
+        # this member validated (and, once armed, the commit boundary
+        # every member applies it at); _swap_gen_seen is the last
+        # generation this member acked/nacked, _swap_applied_gen the
+        # last one it applied. All guarded by _replan_lock.
+        self._pending_swap = None
+        self._swap_gen_seen = 0
+        self._swap_applied_gen = 0
         self._pipe = None
         self._inflight = None
         self._stashed_prefetch = None
@@ -1043,10 +1059,14 @@ class Session:
                         self._flight.record(
                             'replan_staged', world=world,
                             builder=entry['migration_staged'])
-                        with self._replan_lock:
-                            self._pending_replan = {
-                                'strategy': mig, 'world': world,
-                                'entry': entry}
+                        # cohort-wide epoch-swap handshake: stage the
+                        # plan on the control plane, collect the peer
+                        # ack quorum, arm the commit boundary — every
+                        # member (chief included) applies at step B
+                        # through _apply_pending_swap. Runs on this
+                        # re-rank daemon thread; bounded by the
+                        # AUTODIST_SWAP_* knobs.
+                        self._stage_swap(mig, world, entry)
         except Exception as e:  # noqa: BLE001 - advisory, never fatal
             entry['error'] = '%s: %s' % (type(e).__name__, e)
             logging.warning('strategy re-rank for world=%d failed: %s',
@@ -1058,14 +1078,14 @@ class Session:
         PS family with the current strategy's relaxed-consistency flags
         preserved (sync / staleness / shared_optimizer / proxy), so the
         re-plan stays a loose-mode strategy — switching execution MODE
-        (loose <-> SPMD) live would need a new runtime, not a reshard —
-        AND with the current DATA-PLANE GEOMETRY preserved (same shard
-        key layout per variable): live peers keep pulling/pushing the
-        old keys until cohort-wide strategy propagation exists
-        (ROADMAP 3a), so a chief-local migration that re-keyed shards
-        would fork the model between chief and peers. Returns None
-        when the current strategy carries no PS sync to clone flags
-        from, or no geometry-compatible candidate ranks."""
+        (loose <-> SPMD) live would need a new runtime, not a reshard.
+        The top-ranked candidate is returned REGARDLESS of data-plane
+        geometry: re-keyed shards and moved PS endpoints are legal
+        because the epoch-swap handshake (:meth:`_stage_swap`) makes
+        every member apply the new plan at the same step boundary and
+        the chief re-keys the authoritative PS copies before anyone
+        pulls under it. Returns None when the current strategy carries
+        no PS sync to clone flags from, or no candidate ranks."""
         from autodist_tpu.simulator import search
         from autodist_tpu.strategy import builders as b
         from autodist_tpu.strategy.base import PSSynchronizer
@@ -1093,20 +1113,11 @@ class Session:
         feasible, _ = search.rank(
             self._graph_item, rs, candidates=cands, params=params,
             num_replicas=world * max(1, self._plan.local_replicas))
-        names = list(self._graph_item.graph.variables)
-        for cand in feasible:
-            shards = {n.var_name: n.num_shards
-                      for n in cand.strategy.node_config}
-            if all(self._ps_geometry(self._plan, name) ==
-                   (['var/%s/shard%d' % (name, i)
-                     for i in range(shards.get(name, 1))]
-                    if shards.get(name, 1) > 1 else ['var/%s' % name])
-                   for name in names):
-                return cand.strategy
+        if feasible:
+            return feasible[0].strategy
         logging.info(
-            'executed re-plan: no geometry-compatible PS-family '
-            'candidate for world=%d (cohort-wide re-keying needs '
-            'ROADMAP 3a); keeping the current plan', world)
+            'executed re-plan: no PS-family candidate ranked for '
+            'world=%d; keeping the current plan', world)
         return None
 
     def _apply_pending_replan(self):
@@ -1125,7 +1136,302 @@ class Session:
             return ['var/%s/shard%d' % (name, i) for i in range(nshards)]
         return ['var/%s' % name]
 
-    def _execute_replan(self, strategy, world, entry):
+    # -- epoch-swap handshake (docs/design/epoch-swap.md) ------------------
+    # The verified ordering (analysis/epoch_swap_model.py): the chief
+    # STAGES plan N+1 under a generation-keyed plan key, every peer
+    # validates and ACKs (any NACK cancels the stage), the chief ARMS
+    # the commit marker with boundary B = prefix_min(published) +
+    # gate_staleness + 2, and every member — chief included — applies
+    # the staged plan at the start of step B. The boundary-safety
+    # argument: a member executing step s implies every member
+    # published >= s - staleness - 1, so at arm time no member has
+    # started step B and every member's step-B start check observes
+    # the armed marker.
+
+    def _validate_swap_strategy(self, strategy, world):
+        """Can THIS member execute ``strategy`` live? Compiles it and
+        builds its :class:`ExecutionPlan` over this member's mesh (the
+        same construction :meth:`_execute_replan` performs at apply
+        time, so an apply-time failure is caught here, at ack time,
+        where a NACK still cancels the swap cleanly). Raises on any
+        plan this member would have to refuse."""
+        from autodist_tpu.parallel.plan import ExecutionPlan
+        from autodist_tpu.strategy.base import StrategyCompiler
+        compiled = StrategyCompiler(self._graph_item).prune(strategy)
+        new_plan = ExecutionPlan(
+            compiled, self._graph_item, self._mesh,
+            loose=self._loose, topology=self._plan.topology)
+        # weight-update-sharded optimizer slots live as FLAT 1/n
+        # shards; a plan flipping any variable's update-sharding needs
+        # a slot-layout conversion the reshard pass (which moves
+        # var-SHAPED leaves) does not perform — NACK at validation so
+        # no member ever reaches a refusal after the boundary is armed
+        # (PS-family candidates never set update-sharding, so this
+        # only rejects hand-staged exotic plans)
+        wus_moved = [
+            name for name in self._graph_item.graph.variables
+            if getattr(self._plan.var_plans.get(name),
+                       'update_sharded', False) !=
+            getattr(new_plan.var_plans.get(name),
+                    'update_sharded', False)]
+        if wus_moved:
+            raise RuntimeError(
+                'weight-update-sharding layout changes for %s — flat '
+                'slot shards need their own conversion pass'
+                % sorted(wus_moved)[:4])
+        return compiled, new_plan
+
+    def _live_ack_peers(self, client):
+        """The peers whose ACK the staged plan needs RIGHT NOW: live
+        membership (re-evaluated on every poll, so an exclusion mid-
+        handshake shrinks the quorum) minus this worker, minus peers
+        that closed cleanly (done marker / released step sentinel —
+        a finished peer never pulls again and needs no say)."""
+        from autodist_tpu.runtime.coord_client import CLEAN_CLOSE_STEP
+        me = ENV.AUTODIST_PROCESS_ID.val
+        out = []
+        for i in self._live_members():
+            if i == me:
+                continue
+            w = 'p%d' % i
+            if client.get('done/%s' % self._key(w)) is not None:
+                continue
+            if client.incr(self._key('step/') + w, 0) >= \
+                    CLEAN_CLOSE_STEP:
+                continue
+            out.append(i)
+        return out
+
+    def request_strategy_swap(self, strategy, world=None):
+        """Public trigger for a cohort-wide strategy migration: runs
+        the epoch-swap handshake for ``strategy`` on a background
+        thread and returns the audit entry (mutated as the handshake
+        progresses; ``entry['swap']`` appears once the boundary is
+        armed). The swap itself lands when every member's step counter
+        reaches the armed boundary. Loose mode only."""
+        if not self._loose:
+            raise RuntimeError('strategy swap requires loose mode')
+        import threading
+        world = world if world is not None else self._world
+        entry = {'world': world,
+                 'kept': dict(getattr(self._plan.strategy, 'cost',
+                                      None) or {}).get('builder', ''),
+                 'migrated': False, 'requested': True}
+        self._health['replans'].append(entry)
+        t = threading.Thread(
+            target=self._stage_swap, args=(strategy, world, entry),
+            daemon=True, name='autodist-swap-stage')
+        if not hasattr(self, '_replan_threads'):
+            self._replan_threads = []
+        self._replan_threads.append(t)
+        t.start()
+        return entry
+
+    def _stage_swap(self, strategy, world, entry):
+        """Chief half of the epoch-swap handshake: stage -> collect the
+        ack quorum over LIVE membership -> arm the commit boundary.
+        Any NACK or an ack timeout cancels the stage (generation keys
+        deleted) and retries with backoff, bounded by
+        ``AUTODIST_SWAP_MAX_RETRIES``; exhausting the retries degrades
+        to an audit-only entry. Runs on a background thread with its
+        own fenced control-plane connection. Never fatal."""
+        import time as _time
+
+        from autodist_tpu.runtime import swap_keys
+        from autodist_tpu.runtime.coord_client import CLEAN_CLOSE_STEP
+        ack_timeout = ENV.AUTODIST_SWAP_ACK_TIMEOUT_S.val
+        backoff = ENV.AUTODIST_SWAP_RETRY_BACKOFF_S.val
+        max_retries = ENV.AUTODIST_SWAP_MAX_RETRIES.val
+        builder = dict(getattr(strategy, 'cost', None)
+                       or {}).get('builder', '')
+        client = None
+        try:
+            # the staged plan must be executable HERE too: a chief
+            # that arms a plan it later refuses would fork the cohort
+            self._validate_swap_strategy(strategy, world)
+            # own connection: this thread runs beside the main step
+            # loop and CoordClient sockets are not thread-safe
+            client = self._fenced_connect(
+                getattr(self._coord, 'address', None))
+            for attempt in range(max_retries + 1):
+                gen = swap_keys.current_gen(client, self._ns) + 1
+                swap_keys.stage_plan(client, self._ns, gen, world,
+                                     strategy)
+                self._flight.record('swap_stage', gen=gen, world=world,
+                                    builder=builder)
+                logging.info(
+                    'epoch swap gen %d staged for world=%d (%s); '
+                    'waiting for the peer ack quorum', gen, world,
+                    builder or 'hand-staged')
+                deadline = _time.time() + ack_timeout
+                quorum, nacks = False, {}
+                while _time.time() < deadline:
+                    peers = self._live_ack_peers(client)
+                    acked, nacks = swap_keys.read_acks(
+                        client, self._ns, gen, peers)
+                    if nacks:
+                        break
+                    if len(acked) == len(peers):
+                        quorum = True
+                        break
+                    _time.sleep(0.05)
+                if not quorum:
+                    reason = 'nack' if nacks else 'ack_timeout'
+                    swap_keys.cancel(client, self._ns, gen)
+                    self._flight.record(
+                        'swap_cancel', gen=gen, reason=reason,
+                        detail=str(sorted(nacks.items()))[:256])
+                    entry.setdefault('swap_cancels', []).append(
+                        {'gen': gen, 'reason': reason,
+                         'nacks': {('p%d' % w): r
+                                   for w, r in nacks.items()}})
+                    logging.warning(
+                        'epoch swap gen %d cancelled (%s%s)%s', gen,
+                        reason, ': %s' % nacks if nacks else '',
+                        '; retrying after %.1fs' % backoff
+                        if attempt < max_retries else '')
+                    if attempt < max_retries:
+                        _time.sleep(backoff)
+                        continue
+                    entry['migration_skipped'] = (
+                        'epoch-swap handshake failed after %d '
+                        'attempt(s): %s' % (attempt + 1, reason))
+                    return
+                # quorum complete: arm. Boundary floors are the LIVE
+                # members' published counters (sync ROUNDS under a
+                # local-SGD window — the same unit the gate and the
+                # apply check use); released sentinels are skipped.
+                floors = []
+                for i in self._live_members():
+                    f = client.incr(self._key('step/') + 'p%d' % i, 0)
+                    if f < CLEAN_CLOSE_STEP:
+                        floors.append(f)
+                if not floors:
+                    floors = [self._step_count
+                              if self._local_steps == 1
+                              else self._round_count]
+                boundary = swap_keys.compute_boundary(
+                    floors, self._plan.gate_staleness)
+                swap_keys.arm(client, self._ns, gen, boundary)
+                self._flight.record('swap_arm', gen=gen,
+                                    boundary=boundary,
+                                    floor=min(floors))
+                with self._replan_lock:
+                    self._pending_swap = {
+                        'gen': gen, 'strategy': strategy,
+                        'world': world, 'boundary': boundary,
+                        'entry': entry}
+                entry['swap'] = {'gen': gen, 'boundary': boundary,
+                                 'attempts': attempt + 1}
+                logging.info(
+                    'epoch swap gen %d armed: boundary step %d '
+                    '(floor %d + staleness %d + 2)', gen, boundary,
+                    min(floors), self._plan.gate_staleness)
+                return
+        except Exception as e:  # noqa: BLE001 - advisory, never fatal
+            entry['migration_skipped'] = \
+                'epoch-swap staging failed: %s: %s' \
+                % (type(e).__name__, e)
+            logging.warning('epoch-swap staging for world=%d failed: '
+                            '%s', world, entry['migration_skipped'])
+        finally:
+            if client is not None:
+                client.close()
+
+    def _poll_swap_stage(self):
+        """Member half of the handshake, piggybacked on the staleness
+        gate's failure check and on every step start: discover a newly
+        staged generation (validate + ACK, or NACK), and pick up the
+        armed boundary. One counter read on the fast path; never
+        raises (a control-plane hiccup here must not fail the gate
+        slice it rides on)."""
+        if not getattr(self, '_loose', False) \
+                or getattr(self, '_coord', None) is None \
+                or not ENV.AUTODIST_EXECUTE_REPLAN.val:
+            return
+        from autodist_tpu.runtime import swap_keys
+        try:
+            gen = swap_keys.current_gen(self._coord, self._ns)
+            if gen <= 0:
+                return
+            with self._replan_lock:
+                pending = self._pending_swap
+                if pending is not None and pending['gen'] < gen:
+                    # superseded: the chief cancelled this generation
+                    # and re-staged — the new one is validated below
+                    self._pending_swap = pending = None
+            if not self._is_chief and gen > self._swap_gen_seen and \
+                    gen > self._swap_applied_gen:
+                self._swap_gen_seen = gen
+                staged = swap_keys.read_plan(self._coord, self._ns,
+                                             gen)
+                if staged is None:
+                    return   # cancelled between counter and plan read
+                _, world, strategy = staged
+                me = ENV.AUTODIST_PROCESS_ID.val
+                try:
+                    self._validate_swap_strategy(strategy, world)
+                except Exception as e:  # noqa: BLE001 - NACK carries it
+                    reason = '%s: %s' % (type(e).__name__, e)
+                    swap_keys.write_nack(self._coord, self._ns, gen,
+                                         me, reason)
+                    self._flight.record('swap_nack', gen=gen,
+                                        worker=self._worker_name,
+                                        reason=reason[:256])
+                    logging.warning(
+                        'epoch swap gen %d NACKed: %s', gen, reason)
+                    return
+                swap_keys.write_ack(self._coord, self._ns, gen, me)
+                self._flight.record('swap_ack', gen=gen,
+                                    worker=self._worker_name)
+                with self._replan_lock:
+                    self._pending_swap = pending = {
+                        'gen': gen, 'strategy': strategy,
+                        'world': world, 'boundary': 0, 'entry': None}
+            if pending is not None and not pending['boundary']:
+                b = swap_keys.read_boundary(self._coord, self._ns,
+                                            pending['gen'])
+                if b:
+                    with self._replan_lock:
+                        pending['boundary'] = b
+        except Exception as e:  # noqa: BLE001 - poll must not fail
+            logging.debug('epoch-swap poll failed: %s: %s',
+                          type(e).__name__, e)
+
+    def _apply_pending_swap(self):
+        """Apply an armed epoch swap at the start of step B (sync
+        round B under a local-SGD window). Called before anything
+        touches the plan on every run; a member whose counter resumed
+        PAST the boundary (supervised restart) applies on its first
+        run — the chief's re-keyed PS copies are the authoritative
+        state either way."""
+        with self._replan_lock:
+            pending = self._pending_swap
+            if pending is None or not pending.get('boundary'):
+                return
+            h = self._local_steps
+            nxt = self._step_count + 1 if h == 1 \
+                else self._round_count + 1
+            if nxt < pending['boundary'] or \
+                    (h > 1 and self._step_count % h != 0):
+                return
+            self._pending_swap = None
+        entry = pending.get('entry')
+        if entry is None:
+            # non-chief members audit the swap too (the chief's entry
+            # came from its re-rank / request)
+            entry = {'world': pending['world'],
+                     'kept': dict(getattr(self._plan.strategy, 'cost',
+                                          None) or {})
+                     .get('builder', ''),
+                     'migrated': False,
+                     'swap': {'gen': pending['gen'],
+                              'boundary': pending['boundary']}}
+            self._health['replans'].append(entry)
+        self._execute_replan(pending['strategy'], pending['world'],
+                             entry, swap=pending)
+
+    def _execute_replan(self, strategy, world, entry, swap=None):
         """Migrate this session's live state to a re-ranked strategy —
         the execution half of the elastic re-plan (ROADMAP item 3's
         resharding unlock). At a step boundary, atomically:
@@ -1139,18 +1445,31 @@ class Session:
            (carrying entries whose compressor kept shape+keys);
         4. swap the plan and drop compiled steps.
 
-        The shared data plane is deliberately UNTOUCHED: a migration
-        that would change any variable's shard-key geometry or move it
-        between PS endpoints is REFUSED (recorded as
-        ``migration_skipped``) — live peers keep using the old keys
-        until cohort-wide strategy propagation exists (ROADMAP 3a), so
-        ``_build_migratable_strategy`` only stages geometry-compatible
-        candidates and this method re-checks.
+        Without ``swap`` (legacy chief-local call) the shared data
+        plane is UNTOUCHED: a migration that would change any
+        variable's shard-key geometry or move it between PS endpoints
+        is REFUSED (recorded as ``migration_skipped``) — live peers
+        would keep using the old keys.
 
-        Never fatal: everything fallible runs BEFORE the swap and the
-        new state is built entirely on the side, so any failure keeps
-        the old plan + state untouched and records the error on the
-        replan audit entry.
+        With ``swap`` (an ARMED epoch-swap record: every member
+        applies this plan at the same step boundary) re-keying is
+        LEGAL: the chief additionally copies the authoritative PS
+        values of every re-keyed variable old-keys -> new-keys (BSET
+        resets the per-key accumulator state; old keys become inert —
+        a mid-swap zombie's old-plan pushes land where nobody reads,
+        on top of its generation fence) and publishes a ready marker
+        non-chief members wait on before their first new-plan pull.
+        Every member wraps the apply in a snapshot-parity open/close
+        (:meth:`_snap_round_open`), so a serving replica's snapshot
+        pull straddling the migration can never revalidate.
+
+        Never fatal without ``swap``: everything fallible runs BEFORE
+        the swap and the new state is built entirely on the side, so
+        any failure keeps the old plan + state untouched and records
+        the error on the replan audit entry. With ``swap`` a failure
+        AFTER the boundary was armed re-raises instead: other members
+        are applying the plan this member just failed, and training on
+        silently against the old keys would fork the model.
         """
         import time as _time
         t0 = _time.perf_counter()
@@ -1170,15 +1489,13 @@ class Session:
                 if pre is not None:
                     self._account_prefetch_discard(pre)
             variables = list(self._graph_item.graph.variables)
-            # belt-and-braces: _build_migratable_strategy only stages
-            # geometry-compatible strategies, but a re-keying migration
-            # must NEVER execute — live peers keep using the old keys
-            # (cohort-wide propagation is ROADMAP 3a)
+            # without an armed epoch swap a re-keying migration must
+            # NEVER execute — live peers would keep using the old keys
             moved_geom = [
                 name for name in variables
                 if self._ps_geometry(old_plan, name) !=
                 self._ps_geometry(new_plan, name)] if self._loose else []
-            if moved_geom:
+            if moved_geom and swap is None:
                 entry['migration_skipped'] = (
                     'shard geometry changes for %s — re-keying a live '
                     'data plane needs cohort-wide propagation'
@@ -1261,11 +1578,13 @@ class Session:
                                              (n,) + tuple(v.shape)),
                             rep_sharding)
                         for k, v in aux.items()}
-            # new endpoint placement is computed on the side too, and
-            # an index that MOVES any live variable between endpoints
+            # new endpoint placement is computed on the side too; an
+            # index that MOVES any live variable between endpoints
             # aborts like a geometry change would (peers keep dialing
-            # the old endpoints)
+            # the old endpoints) — unless an armed epoch swap makes
+            # every member adopt the new placement at the boundary
             new_ps_index = self._ps_index
+            moved_eps = []
             if self._loose:
                 from autodist_tpu.runtime import coord_client as cc
                 eps = cc.ps_endpoints()
@@ -1277,7 +1596,7 @@ class Session:
                         if self._ps_index.get(name) is not None
                         and new_ps_index.get(name) !=
                         self._ps_index.get(name)]
-                    if moved_eps:
+                    if moved_eps and swap is None:
                         entry['migration_skipped'] = (
                             'endpoint placement moves for %s — '
                             'needs cohort-wide propagation'
@@ -1291,6 +1610,33 @@ class Session:
                         self._flight.dump('replan_refusal')
                         return
             # ---- swap (everything above built on the side) ----
+            # epoch swap: the data-plane re-key brackets the plan swap
+            # in a snapshot-parity open/close — a serving replica's
+            # epoch-consistent pull straddling the migration pins an
+            # odd (or advanced) parity and can never revalidate a
+            # snapshot that mixes old- and new-key reads
+            rekeyed = sorted(set(moved_geom) | set(moved_eps)) \
+                if swap is not None else []
+            auth = {}
+            if swap is not None and self._loose:
+                self._snap_round_open(self._coord, self._worker_name)
+            if rekeyed and self._is_chief and self._loose:
+                # authoritative PS values under the OLD keys (the PS
+                # copy, not this worker's possibly-stale local state,
+                # is the model) — fetched before the plan swap flips
+                # _shard_info to the new layout
+                parts, _ = self._fetch_var_parts(rekeyed)
+                for name in rekeyed:
+                    pc, _keys = self._shard_info(name)
+                    got = parts.get(name, [None])
+                    if any(p is None for p in got):
+                        # never stored (init-barrier window): the local
+                        # device copy is the best value in existence
+                        auth[name] = np.asarray(self._plan.unpad_host(
+                            name, np.asarray(self._var_state[name])))
+                    else:
+                        auth[name] = got[0] if pc is None \
+                            else pc.merge(got)
             self._plan = new_plan
             self._var_state = new_vars
             self._opt_state = new_opt
@@ -1311,6 +1657,39 @@ class Session:
                 and len(p.var.shape) == 2
                 and (p.num_shards <= 1 or p.partition_axis == 0)}
             self._ps_index = new_ps_index
+            if swap is not None and self._loose:
+                from autodist_tpu.runtime import swap_keys
+                try:
+                    if self._is_chief:
+                        if auth:
+                            # re-key: authoritative values land under
+                            # the NEW plan's keys (BSET resets each
+                            # key's accumulator/slot state wholesale);
+                            # the old keys become inert — nobody reads
+                            # them, zombie old-plan pushes land there
+                            # harmlessly, and the run-end purge sweeps
+                            # them
+                            self._store_var_parts(auth)
+                        swap_keys.mark_ready(self._coord, self._ns,
+                                             swap['gen'])
+                    elif rekeyed:
+                        # the chief may reach its own boundary later
+                        # than us: our first new-plan pull must not
+                        # race the re-key
+                        swap_keys.wait_ready(
+                            self._coord, self._ns, swap['gen'],
+                            ENV.AUTODIST_SWAP_ACK_TIMEOUT_S.val)
+                finally:
+                    self._snap_round_close(self._coord,
+                                           self._worker_name)
+                self._swap_applied_gen = swap['gen']
+                self._flight.record(
+                    'swap_apply', gen=swap['gen'],
+                    worker=self._worker_name,
+                    boundary=swap['boundary'],
+                    step=self._step_count + 1
+                    if self._local_steps == 1
+                    else self._round_count + 1)
             entry['migrated'] = True
             entry['migration'] = {
                 'world': world,
@@ -1318,6 +1697,13 @@ class Session:
                                 or {}).get('builder', ''),
                 'strategy_id': compiled.id,
                 'reshard': reshard_mod.summarize(ops),
+                'rekeyed_vars': len(rekeyed),
+                # bytes the re-key pushed to the NEW PS keys (the
+                # authoritative-copy BSETs) — the reshard summary only
+                # counts device-collective wire bytes, which are 0 for
+                # a single-host re-partition
+                'rekey_ps_bytes': int(sum(
+                    np.asarray(v).nbytes for v in auth.values())),
                 'wall_s': round(_time.perf_counter() - t0, 4)}
             self._flight.record(
                 'replan_swap', world=world,
@@ -1342,6 +1728,12 @@ class Session:
             self._flight.record('replan_failed', world=world,
                                 error=entry['migration_error'])
             self._flight.dump('replan_failure')
+            if swap is not None:
+                # past an armed boundary the cohort is committed: the
+                # other members are applying the plan this member just
+                # failed — training on against the old keys would fork
+                # the model silently. Fail fast instead.
+                raise
 
     def _exclude_peer(self, wkey, timeout):
         """Epoch-fenced exclusion of a dead peer. Every detector fences
@@ -1431,6 +1823,11 @@ class Session:
             logging.warning('membership epoch advanced to %d: %d '
                             'active workers', epoch,
                             self._active_workers())
+        # the epoch-swap handshake piggybacks on the gate poll: a
+        # member blocked here for a whole staleness window still
+        # discovers (and acks) a staged plan and picks up the armed
+        # boundary without waiting for its next step start
+        self._poll_swap_stage()
         timeout = ENV.AUTODIST_HEARTBEAT_TIMEOUT.val
         if not timeout:
             return
@@ -2112,6 +2509,12 @@ class Session:
         # the step boundary, before anything touches the plan
         if self._pending_replan is not None:
             self._apply_pending_replan()
+        # epoch-swap handshake: discover/ack staged plans and — once
+        # the commit marker is armed and our counter reaches the
+        # boundary — apply the cohort's new plan before this step
+        if self._loose:
+            self._poll_swap_stage()
+            self._apply_pending_swap()
         feed_dict = feed_dict or {}
         single = not isinstance(fetches, (list, tuple))
         fetch_list = [fetches] if single else list(fetches)
@@ -3125,6 +3528,16 @@ class Session:
                 try:
                     self._coord.delete_namespace(
                         self._key('telemetry/'))
+                except Exception:  # noqa: BLE001 - service may be gone
+                    pass
+                # staged epoch-swap plans must not outlive the run
+                # either, even when the purge quorum below is never
+                # reached: a restarted run (same deterministic ns)
+                # must never validate — let alone apply — a dead
+                # cohort's staged generation
+                try:
+                    from autodist_tpu.runtime import swap_keys
+                    swap_keys.purge_all(self._coord, self._ns)
                 except Exception:  # noqa: BLE001 - service may be gone
                     pass
             self._flight.record('close', worker=self._worker_name,

@@ -6,6 +6,13 @@ processes (the mode decision) while the session sees 1 (no peers to
 barrier with) — the same data plane either way. bench.py's ps-pipeline
 A/B and tests/test_async_ps.py both ride this helper so the dance
 lives in exactly one place.
+
+This module also hosts :func:`ack_staged_swaps`, the swap-handshake
+half of a SIMULATED peer: tests and benches that fake a cohort member
+with a bare coord client (publish step, heartbeat, release) must also
+speak the epoch-swap ack protocol or the chief's ack quorum would
+never fill.  One helper, called from every simulated-peer loop, keeps
+that protocol in one place too.
 """
 import os
 from contextlib import contextmanager
@@ -51,3 +58,30 @@ def single_process_loose_env(coord_port, depth):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def ack_staged_swaps(client, ns, worker, seen):
+    """One poll of the epoch-swap handshake for a SIMULATED peer.
+
+    Call from the simulated peer's publish loop.  ``seen`` is a
+    mutable set of generations this peer already acked (owned by the
+    caller so the helper stays stateless).  Any newly staged
+    generation is acked unconditionally — a bare-client peer has no
+    mesh to validate the plan against, and these harness peers exist
+    to exercise the chief's staging/arming machinery, not the
+    validator.  Returns ``(gen, boundary)`` of the latest armed
+    generation (``(0, 0)`` if none) so a caller that wants to stop
+    publishing near the boundary can.
+    """
+    from autodist_tpu.runtime import swap_keys
+    gen = swap_keys.current_gen(client, ns)
+    if gen <= 0:
+        return 0, 0
+    if gen not in seen:
+        # plan may already be cancelled by the time we look; only a
+        # visible payload earns an ack (matches the real peer, which
+        # keys every decision off the plan's presence)
+        if swap_keys.read_plan(client, ns, gen) is not None:
+            swap_keys.write_ack(client, ns, gen, worker)
+            seen.add(gen)
+    return gen, swap_keys.read_boundary(client, ns, gen)

@@ -1969,7 +1969,8 @@ def _elastic_run(port, steps, join_at=None, staleness=1, dim=48):
     import autodist_tpu as ad
     from autodist_tpu.runtime.coord_client import CoordClient
     from autodist_tpu.runtime.session import admit_worker
-    from autodist_tpu.utils.loose_harness import single_process_loose_env
+    from autodist_tpu.utils.loose_harness import (ack_staged_swaps,
+                                                  single_process_loose_env)
     from autodist_tpu.utils.profiling import health_report
 
     with single_process_loose_env(port, depth=1):
@@ -2000,9 +2001,14 @@ def _elastic_run(port, steps, join_at=None, staleness=1, dim=48):
                 c.heartbeat('%s/p1' % ns)
                 peer_ready.set()
                 c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+                seen = set()
                 for s in range(1, steps + 1):
                     c.heartbeat('%s/p1' % ns)
                     c.publish_step('p1', s, prefix='%s/step/' % ns)
+                    # the chief's re-rank stages an epoch swap
+                    # (AUTODIST_EXECUTE_REPLAN=1): ack it so the
+                    # quorum fills and the migration can arm
+                    ack_staged_swaps(c, ns, 1, seen)
                     time.sleep(0.05)
                 c.set('done/%s/p1' % ns, '1')
                 c.publish_step('p1', 1 << 30, prefix='%s/step/' % ns)
@@ -2019,9 +2025,11 @@ def _elastic_run(port, steps, join_at=None, staleness=1, dim=48):
                 admit = admit_worker(c, ns)
                 admit_rec.update(admit)
                 me = admit['worker']
+                seen = set()
                 for s in range(admit['adopted_step'] + 1, steps + 1):
                     c.heartbeat('%s/%s' % (ns, me))
                     c.publish_step(me, s, prefix='%s/step/' % ns)
+                    ack_staged_swaps(c, ns, int(me[1:]), seen)
                     time.sleep(0.05)
                 c.set('done/%s/%s' % (ns, me), '1')
                 c.publish_step(me, 1 << 30, prefix='%s/step/' % ns)
@@ -2120,6 +2128,231 @@ def _bench_elastic_inner(steps, join_at):
              if r.get(k) is not None}
             for r in report.get('replans', [])],
     }
+
+
+def bench_epoch_swap(steps=6, swap_at=2):
+    """Epoch-swap A/B (PR 19 acceptance).
+
+    Runs the SAME 2-worker loose chief workload twice: a control leg
+    that never migrates, and a swap leg that — after ``swap_at`` timed
+    steps — requests a cohort-wide migration to a re-keying
+    PartitionedPS plan through the full epoch-swap handshake
+    (stage -> peer ack quorum -> armed boundary -> boundary apply via
+    the reshard path). Records the handshake trajectory: steps from
+    request to the armed boundary, steps stalled by the swap, bytes
+    the re-key moved over the PS wire, and the final-state max abs
+    diff vs the control leg — the migration moves values, never
+    recomputes them, so the expected divergence is 0.0 (-1.0 is the
+    failure sentinel: the migration did not land).
+
+    Never raises: hosts without g++ (no coord_service) degrade to
+    ``{'error': ...}`` so the bench still emits its one JSON line.
+    """
+    try:
+        return _bench_epoch_swap_inner(steps, swap_at)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _epoch_swap_run(port, steps, swap_at=None, train_total=None,
+                    staleness=1, dim=48):
+    """One chief run beside a simulated acking peer p1. With
+    ``swap_at``, after that many timed steps the chief hand-stages a
+    PartitionedPS migration via ``request_strategy_swap`` and keeps
+    training until the armed boundary applies it (bounded). Returns
+    (per-step walls, final W, swap audit entry or None, step count at
+    request time, total trained steps)."""
+    import threading
+
+    import autodist_tpu as ad
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.utils.loose_harness import (ack_staged_swaps,
+                                                  single_process_loose_env)
+
+    with single_process_loose_env(port, depth=1):
+        autodist = ad.AutoDist(
+            resource_info={'nodes': [
+                {'address': 'localhost', 'gpus': [0], 'chief': True,
+                 'network_bandwidth': 100}]},
+            strategy_builder=ad.strategy.PS(staleness=staleness))
+        rng = np.random.RandomState(0)
+        W0 = rng.randn(dim, 3).astype(np.float32)
+        feed = rng.randn(8, dim).astype(np.float32)
+        with autodist.scope():
+            x = ad.placeholder(shape=[None, dim], dtype=np.float32,
+                               name='x')
+            W = ad.Variable(W0, name='W')
+            loss = ad.ops.reduce_mean(
+                ad.ops.square(ad.ops.matmul(x, W)))
+            train_op = ad.optimizers.SGD(0.1).minimize(loss, [W])
+            autodist._build()
+            ns = autodist._transformed[0].id
+            peer_ready = threading.Event()
+            stop = threading.Event()
+
+            def peer():
+                c = CoordClient(('127.0.0.1', port))
+                gen = c.incr('fence/%s/p1' % ns, 0)
+                c.fence('fence/%s/p1' % ns, gen)
+                c.heartbeat('%s/p1' % ns)
+                peer_ready.set()
+                c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+                seen, s = set(), 0
+                deadline = time.time() + 120.0
+                while not stop.is_set() and time.time() < deadline:
+                    s += 1
+                    c.heartbeat('%s/p1' % ns)
+                    c.publish_step('p1', s, prefix='%s/step/' % ns)
+                    # the swap leg stages a plan: speak the ack half
+                    # of the handshake so the chief's quorum fills
+                    ack_staged_swaps(c, ns, 1, seen)
+                    time.sleep(0.05)
+                c.set('done/%s/p1' % ns, '1')
+                c.publish_step('p1', 1 << 30, prefix='%s/step/' % ns)
+                c.close()
+
+            t = threading.Thread(target=peer, daemon=True)
+            t.start()
+            peer_ready.wait(30.0)
+            sess = autodist.create_distributed_session()
+            # compile + warmup outside the timed walls (both legs pay
+            # it identically)
+            sess.run(train_op, {x: feed})
+            trained, walls, entry, request_step = 1, [], None, None
+
+            def timed_step():
+                t0 = time.perf_counter()
+                sess.run(train_op, {x: feed})
+                walls.append(time.perf_counter() - t0)
+
+            if swap_at is not None:
+                for _ in range(swap_at):
+                    timed_step()
+                    trained += 1
+                # hand-build the re-keying target: PartitionedPS over
+                # the same relaxed-consistency flags. dim=48 shards
+                # axis 0 in two, so the swap genuinely re-keys — the
+                # geometry change only the armed handshake makes legal
+                from autodist_tpu.strategy import builders as b
+                rs = getattr(sess._cluster, '_resource_spec', None)
+                mig = b.PartitionedPS(
+                    sync=True, staleness=staleness).build(
+                        sess._graph_item, rs)
+                try:
+                    mig.cost = {'builder': 'PartitionedPS'}
+                except Exception:   # noqa: BLE001 - label only
+                    pass
+                request_step = trained
+                entry = sess.request_strategy_swap(mig)
+                # keep TRAINING to the armed boundary (fetch-only runs
+                # never advance the step counter, so they can never
+                # reach B), bounded
+                deadline = time.time() + 60.0
+                while (trained < steps + 1
+                       or (time.time() < deadline and trained < 60
+                           and not (entry.get('migrated')
+                                    or entry.get('migration_error')
+                                    or entry.get('migration_skipped')))):
+                    timed_step()
+                    trained += 1
+            else:
+                for _ in range((train_total or steps + 1) - trained):
+                    timed_step()
+                    trained += 1
+            w_final = sess.get_variable_value('W')
+            stop.set()
+            sess.close()
+            t.join(timeout=15.0)
+        return walls, w_final, entry, request_step, trained
+
+
+def _bench_epoch_swap_inner(steps, swap_at):
+    import socket
+
+    from autodist_tpu.runtime.coord_client import (CoordClient,
+                                                   ensure_service)
+
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = ensure_service(port=port)
+    saved = {k: os.environ.get(k)
+             for k in ('AUTODIST_PEER_FAILURE_POLICY',
+                       'AUTODIST_HEARTBEAT_TIMEOUT',
+                       'AUTODIST_EXECUTE_REPLAN',
+                       'AUTODIST_IS_TESTING')}
+    os.environ['AUTODIST_PEER_FAILURE_POLICY'] = 'exclude'
+    os.environ['AUTODIST_HEARTBEAT_TIMEOUT'] = '5.0'
+    # the member half of the handshake (_poll_swap_stage /
+    # _apply_pending_swap) only runs under the executed-replan knob
+    os.environ['AUTODIST_EXECUTE_REPLAN'] = '1'
+    # the single-endpoint harness would otherwise collapse
+    # PartitionedPS to one shard (builders.py ref :81-87) and the swap
+    # would not re-key; the testing knob keeps the partitioner honest
+    os.environ['AUTODIST_IS_TESTING'] = '1'
+    try:
+        (walls, w_swap, entry, request_step,
+         trained) = _epoch_swap_run(port, steps, swap_at=swap_at)
+        base_walls, w_ctrl, _, _, _ = _epoch_swap_run(
+            port, steps, train_total=trained)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            CoordClient(('127.0.0.1', port)).shutdown()
+            if proc is not None:
+                proc.wait(timeout=5)
+        except Exception:   # noqa: BLE001 - results already in hand
+            if proc is not None:
+                proc.kill()
+    entry = entry or {}
+    migrated = bool(entry.get('migrated'))
+    swap = entry.get('swap') or {}
+    mig = entry.get('migration') or {}
+    reshard = mig.get('reshard') or {}
+    base_mean = float(np.mean(base_walls)) if base_walls else 0.0
+    # a step stalled by the swap (handshake wait at the gate or the
+    # apply itself) stands far above the control leg's mean wall
+    thresh = max(0.05, 4.0 * base_mean)
+    post = walls[request_step - 1:] if request_step else walls
+    downtime = [w for w in post if w > thresh]
+    clean = [w for w in walls if w <= thresh]
+    rec = {
+        'steps': trained,
+        'swap_requested_at_step': request_step,
+        'migrated': migrated,
+        'builder': mig.get('builder') or 'PartitionedPS',
+        'swap_gen': swap.get('gen'),
+        'swap_boundary': swap.get('boundary'),
+        'swap_attempts': swap.get('attempts'),
+        'steps_to_boundary': (swap['boundary'] - request_step
+                              if swap.get('boundary') is not None
+                              and request_step is not None else None),
+        'swap_downtime_steps': len(downtime),
+        # total bytes the migration moved: device-collective reshard
+        # wire bytes + the chief's re-key BSETs to the new PS keys
+        'bytes_resharded': (reshard.get('wire_bytes', 0)
+                            + mig.get('rekey_ps_bytes', 0))
+        if mig else None,
+        'resharded_vars': reshard.get('vars'),
+        'rekeyed_vars': mig.get('rekeyed_vars'),
+        'migration_wall_s': mig.get('wall_s'),
+        'mean_step_wall_s': round(float(np.mean(clean)), 5)
+        if clean else 0.0,
+        'baseline_mean_step_wall_s': round(base_mean, 5),
+        # the migration moved values, never recomputed them: expected
+        # 0.0; -1.0 = the swap never landed (failure sentinel)
+        'state_max_abs_diff': float(np.abs(w_swap - w_ctrl).max())
+        if migrated else -1.0,
+    }
+    for k in ('migration_skipped', 'migration_error', 'swap_cancels'):
+        if entry.get(k):
+            rec[k] = entry[k]
+    return rec
 
 
 def bench_telemetry(steps=10):
@@ -2888,6 +3121,7 @@ def main():
         result['extra']['recovery'] = bench_recovery()
         result['extra']['sparse_ps'] = bench_sparse_ps()
         result['extra']['elastic'] = bench_elastic()
+        result['extra']['epoch_swap'] = bench_epoch_swap()
         result['extra']['quantized'] = bench_quantized()
         result['extra']['hierarchical'] = bench_hierarchical()
         result['extra']['weight_update'] = bench_weight_update()
@@ -2917,6 +3151,7 @@ def main():
     recovery = bench_recovery()
     sparse_ps = bench_sparse_ps()
     elastic = bench_elastic()
+    epoch_swap = bench_epoch_swap()
     quantized = bench_quantized()
     hierarchical = bench_hierarchical()
     weight_update = bench_weight_update()
@@ -2948,6 +3183,7 @@ def main():
                 'recovery': recovery,
                 'sparse_ps': sparse_ps,
                 'elastic': elastic,
+                'epoch_swap': epoch_swap,
                 'quantized': quantized,
                 'hierarchical': hierarchical,
                 'weight_update': weight_update,
@@ -3012,6 +3248,7 @@ def main():
                       'recovery': recovery,
                       'sparse_ps': sparse_ps,
                       'elastic': elastic,
+                      'epoch_swap': epoch_swap,
                       'quantized': quantized,
                       'hierarchical': hierarchical,
                       'weight_update': weight_update,

@@ -121,7 +121,8 @@ def test_data_plane_head_explores_clean():
     results = [explore.explore(sc) for sc in dp.scenarios(dp.HEAD)]
     assert {r.scenario for r in results} == {
         'torn_write', 'writer_death', 'zombie_sparse', 'pipeline',
-        'telemetry', 'local_sgd', 'reader_fleet'}
+        'telemetry', 'local_sgd', 'reader_fleet',
+        'reader_fleet_swap'}
     for r in results:
         assert r.ok, '\n'.join(explore.format_violation(r, v)
                                for v in r.violations)
@@ -194,6 +195,22 @@ def test_data_plane_rederives_pr11_cursor_race():
     assert 'skipped it permanently' in v.diagnosis
 
 
+def test_data_plane_rederives_swap_silent_rekey():
+    """Golden trace (PR 19): dropping the snapshot-parity bracket
+    around the epoch-swap re-key (``swap_parity='silent'``) lets a
+    serving replica revalidate — and accept — a snapshot that mixes
+    the old and new shard layouts across the swap boundary."""
+    from autodist_tpu.analysis import data_plane_model as dp, explore
+    r = explore.explore(_dp_scenario(dp.SWAP_SILENT_REKEY,
+                                     'reader_fleet_swap'))
+    assert 'swap-torn-snapshot' in r.kinds(), r.kinds()
+    v = [v for v in r.violations
+         if v.kind == 'swap-torn-snapshot'][0]
+    text = explore.format_violation(r, v)
+    print('\n' + text)
+    assert text.splitlines()[1].strip().startswith('1.')
+
+
 def test_data_plane_extra_seeded_orderings():
     """The non-historical seeded orderings of the same classes: the
     entry-only fence check lets a zombie BSADD frame commit; serving
@@ -251,11 +268,11 @@ def test_data_plane_sensitivity_guard():
         assert any('lost the sensitivity' in f for f in findings)
     finally:
         dp.SEEDED_BUGS = saved
-    # every exploration (7 HEAD scenarios + 9 seeds — two of which
+    # every exploration (8 HEAD scenarios + 10 seeds — two of which
     # share scenario+kind) gets its own stats entry: a blowup in the
     # second pipeline seed must not hide behind the first's count
     dp.analyze()
-    assert len(dp.LAST_STATS['scenarios']) == 16, dp.LAST_STATS
+    assert len(dp.LAST_STATS['scenarios']) == 18, dp.LAST_STATS
     assert dp.LAST_STATS['states_explored'] == sum(
         dp.LAST_STATS['scenarios'].values())
 
@@ -700,7 +717,7 @@ def test_analyze_cli_all_json():
     assert report['schema_version'] >= 2
     assert set(report['analyzers']) == {'protocol', 'data-plane',
                                         'epoch-swap', 'fence', 'env',
-                                        'schedule'}
+                                        'schedule', 'swap-conformance'}
     for rec in report['analyzers'].values():
         assert rec['findings'] == []
         assert rec['elapsed_s'] >= 0

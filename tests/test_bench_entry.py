@@ -59,6 +59,20 @@ def test_bench_cpu_smoke_emits_one_json_line():
         assert el['admit_wall_s'] > 0
         assert el['state_max_abs_diff'] == 0.0
         assert el['replans']
+    # PR 19: every record carries the epoch-swap A/B under its stable
+    # key — the hand-staged PartitionedPS migration ran the full
+    # handshake (gen staged, boundary armed, re-key moved bytes) and
+    # the migration moved values, never recomputed them (0.0 diff;
+    # -1.0 is the swap-never-landed sentinel)
+    ep = extra['epoch_swap']
+    if shutil.which('g++'):
+        assert 'error' not in ep, ep
+        assert ep['migrated'] is True, ep
+        assert ep['swap_gen'] >= 1 and ep['swap_boundary'] >= 1, ep
+        assert ep['steps_to_boundary'] >= 1, ep
+        assert ep['rekeyed_vars'] >= 1, ep
+        assert ep['bytes_resharded'] > 0, ep
+        assert ep['state_max_abs_diff'] == 0.0, ep
     # ISSUE 17: every record carries the train-while-serve A/B under
     # its stable key — the replica fleet really served during training
     # (snapshots pulled, lookups answered) and every consistency gate

@@ -763,3 +763,400 @@ def test_prebarrier_replacement_fills_barrier_slot(service,
         c.close()
     finally:
         h.close()
+
+
+# ---------------------------------------------------------------------------
+# PR 19: epoch-swap handshake chaos matrix (docs/design/epoch-swap.md).
+# The strategy-distribution epoch's stage -> ack-quorum -> arm ->
+# boundary-apply handshake under a peer death at EVERY stage: the
+# faultline kills the simulated peer at an exact protocol point, and
+# the surviving chief must still converge on exactly one applied
+# generation (quorum re-evaluation over live membership degrades the
+# dead peer through exclude/fence).
+# ---------------------------------------------------------------------------
+
+#: The death-sentinel step the swap peer publishes to trigger its armed
+#: kill_worker fault: the faultline intercepts the publish ON THE WIRE
+#: (the sentinel never lands on the counter) and raises InjectedFault,
+#: so the death happens at an exact handshake point rather than
+#: "roughly when a sleep elapses". Below CLEAN_CLOSE_STEP so the hook
+#: does not mistake it for a release.
+_SWAP_DIE_STEP = 4096
+
+
+def _since_run_start(events):
+    """The tail of the PROCESS-WIDE flight ring belonging to the
+    current session (everything after its ``run_start``): assertions
+    about "this run's" swap events must not see a previous test's."""
+    for i in range(len(events) - 1, -1, -1):
+        if events[i].get('kind') == 'run_start':
+            return events[i:]
+    return events
+
+
+def _swap_peer_loop(port, ns, die_at, out, stop, interval=0.03,
+                    deadline_s=40.0):
+    """Swap-aware simulated peer: the normal worker protocol (fence,
+    heartbeat, init barrier, step publishes) plus one epoch-swap
+    handshake poll per step (loose_harness.ack_staged_swaps). ``die_at``
+    names the handshake point at which this incarnation publishes the
+    faultline's death sentinel (None = survive to a clean close):
+
+    - ``'stage'``   on first observing a staged plan — it never acks,
+                    so the quorum only fills once the death is
+                    excluded out of the live membership;
+    - ``'ack'``     the moment its own ack has landed;
+    - ``'arm'``     on first observing the armed boundary, before its
+                    counter reaches it;
+    - ``'midswap'`` after publishing PAST the boundary (the chief may
+                    be mid-apply when the silence starts).
+    """
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.utils.loose_harness import ack_staged_swaps
+    c = CoordClient(('127.0.0.1', port))
+    try:
+        gen = c.incr('fence/%s/p1' % ns, 0)
+        c.fence('fence/%s/p1' % ns, gen)
+        c.heartbeat('%s/p1' % ns)
+        c.barrier('%s/session/init' % ns, 2, timeout_s=60.0)
+        seen = set()
+        s = 0
+        deadline = time.time() + deadline_s
+        while time.time() < deadline and not stop.is_set():
+            c.heartbeat('%s/p1' % ns)
+            s += 1
+            c.publish_step('p1', s, prefix='%s/step/' % ns)
+
+            def die(point):
+                out['died'] = {'at': point, 'step': s}
+                c.publish_step('p1', _SWAP_DIE_STEP,
+                               prefix='%s/step/' % ns)
+
+            g = swap_keys.current_gen(c, ns)
+            staged = bool(g) and \
+                swap_keys.read_plan(c, ns, g) is not None
+            if die_at == 'stage' and staged:
+                die('stage')
+            g, b = ack_staged_swaps(c, ns, 1, seen)
+            if die_at == 'ack' and g in seen:
+                die('ack')
+            if die_at == 'arm' and b:
+                die('arm')
+            if die_at == 'midswap' and b and s >= b:
+                die('midswap')
+            out['step'] = s
+            time.sleep(interval)
+        if die_at is None:
+            c.set('done/%s/p1' % ns, '1')
+            c.publish_step('p1', 1 << 30, prefix='%s/step/' % ns)
+    finally:
+        c.close()
+
+
+@pytest.mark.parametrize('die_at', ['stage', 'ack', 'arm', 'midswap'])
+def test_swap_peer_killed_at_each_handshake_stage(service, monkeypatch,
+                                                  die_at):
+    """PR 19 acceptance matrix: a peer killed by a seeded faultline at
+    each of the four handshake stages. The survivors converge on
+    exactly ONE generation (staged once, armed once, applied at or
+    after the boundary, never cancelled), the chief's trajectory stays
+    the serial ground truth (a same-strategy swap moves values, never
+    recomputes them), and the chief's own flight trace replays clean
+    through the swap-conformance invariants."""
+    from autodist_tpu.analysis import swap_conformance
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.utils.faultline import (FaultLine, FaultPlan,
+                                              InjectedFault)
+    monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '1.0')
+    monkeypatch.setenv('AUTODIST_EXECUTE_REPLAN', '1')
+    monkeypatch.setenv('AUTODIST_SWAP_ACK_TIMEOUT_S', '20')
+    monkeypatch.setenv('AUTODIST_SWAP_MAX_RETRIES', '0')
+    h = _ChiefHarness(service)
+    try:
+        plan = FaultPlan([{'kind': 'kill_worker', 'worker': 'p1',
+                           'step': _SWAP_DIE_STEP, 'mode': 'raise'}],
+                         seed=19)
+        out = {}
+        stop = threading.Event()
+
+        def peer():
+            try:
+                _swap_peer_loop(service, h.ns, die_at, out, stop)
+            except InjectedFault as e:
+                out['fault'] = str(e)   # death: no done marker, silence
+
+        t = threading.Thread(target=peer, daemon=True)
+        with FaultLine(plan, worker='p1') as fl:
+            t.start()
+            sess = h.create_session()
+            for _ in range(2):
+                sess.run(h.train_op, {h.x: h.feed})
+            entry = sess.request_strategy_swap(sess._plan.strategy)
+            trained = 2
+            deadline = time.time() + 60.0
+            while time.time() < deadline and trained < 80:
+                sess.run(h.train_op, {h.x: h.feed})
+                trained += 1
+                if entry.get('migrated') or \
+                        entry.get('migration_error') or \
+                        entry.get('migration_skipped'):
+                    break
+            w_final = sess.get_variable_value('W')
+            events = _since_run_start(list(sess._flight.events()))
+        stop.set()
+        t.join(timeout=10.0)
+        assert out.get('fault'), 'faultline never killed the peer'
+        assert out['died']['at'] == die_at
+        assert [e['kind'] for e in fl.events] == ['kill_worker']
+        # the handshake completed on the first staged generation
+        assert entry.get('migrated') is True, entry
+        swap = entry['swap']
+        assert swap['gen'] == 1 and swap['attempts'] == 1
+        assert swap['boundary'] >= 1
+        assert 'swap_cancels' not in entry
+        # bit-exact survivor trajectory: the swap moved state, the
+        # dead peer pushed no deltas, so the chief's walk IS serial
+        np.testing.assert_allclose(
+            w_final, _ground_truth(h.W0, h.feed, trained),
+            rtol=2e-4, atol=2e-5)
+        # one generation end to end: staged once, armed once, applied
+        # at/after the boundary, never cancelled
+        swaps = [e for e in events if e['kind'].startswith('swap_')]
+        assert [e['gen'] for e in swaps
+                if e['kind'] == 'swap_stage'] == [1]
+        assert [e['gen'] for e in swaps
+                if e['kind'] == 'swap_arm'] == [1]
+        applies = [e for e in swaps if e['kind'] == 'swap_apply']
+        assert [e['gen'] for e in applies] == [1]
+        assert applies[0]['step'] >= swap['boundary']
+        assert not [e for e in swaps if e['kind'] == 'swap_cancel']
+        # the chief's live trace conforms to the verified model
+        assert swap_conformance.check_swap_events(events) == []
+        # and the wire agrees: one staged generation, still visible
+        c = CoordClient(('127.0.0.1', service))
+        assert swap_keys.current_gen(c, h.ns) == 1
+        assert swap_keys.read_plan(c, h.ns, 1) is not None
+        c.close()
+    finally:
+        h.close()
+
+
+def test_swap_nack_cancels_cleanly(service, monkeypatch):
+    """Any NACK cancels the stage: the generation's subtree is deleted
+    (plan, acks, armed marker), the audit entry records the per-worker
+    reason, no boundary is ever armed, and the cohort trains on under
+    the old plan along the unchanged trajectory."""
+    from autodist_tpu.analysis import swap_conformance
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    monkeypatch.setenv('AUTODIST_EXECUTE_REPLAN', '1')
+    monkeypatch.setenv('AUTODIST_SWAP_ACK_TIMEOUT_S', '20')
+    monkeypatch.setenv('AUTODIST_SWAP_MAX_RETRIES', '0')
+    h = _ChiefHarness(service)
+    try:
+        stop = threading.Event()
+
+        def peer():
+            c = CoordClient(('127.0.0.1', service))
+            try:
+                gen = c.incr('fence/%s/p1' % h.ns, 0)
+                c.fence('fence/%s/p1' % h.ns, gen)
+                c.heartbeat('%s/p1' % h.ns)
+                c.barrier('%s/session/init' % h.ns, 2, timeout_s=60.0)
+                s = 0
+                nacked = False
+                deadline = time.time() + 40.0
+                while time.time() < deadline and not stop.is_set():
+                    c.heartbeat('%s/p1' % h.ns)
+                    s += 1
+                    c.publish_step('p1', s, prefix='%s/step/' % h.ns)
+                    g = swap_keys.current_gen(c, h.ns)
+                    if g and not nacked and \
+                            swap_keys.read_plan(c, h.ns, g) is not None:
+                        swap_keys.write_nack(c, h.ns, g, 1,
+                                             'validator says no')
+                        nacked = True
+                    time.sleep(0.03)
+                c.set('done/%s/p1' % h.ns, '1')
+                c.publish_step('p1', 1 << 30, prefix='%s/step/' % h.ns)
+            finally:
+                c.close()
+
+        t = threading.Thread(target=peer, daemon=True)
+        t.start()
+        sess = h.create_session()
+        steps = 4
+        for _ in range(steps):
+            sess.run(h.train_op, {h.x: h.feed})
+        entry = sess.request_strategy_swap(sess._plan.strategy)
+        deadline = time.time() + 30.0
+        while time.time() < deadline and \
+                not entry.get('migration_skipped'):
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10.0)
+        assert 'handshake failed' in entry.get('migration_skipped', ''), \
+            entry
+        assert entry['swap_cancels'] == [
+            {'gen': 1, 'reason': 'nack',
+             'nacks': {'p1': 'validator says no'}}]
+        assert 'swap' not in entry and entry['migrated'] is False
+        # the stage was withdrawn cleanly: subtree gone, counter kept
+        c = CoordClient(('127.0.0.1', service))
+        assert swap_keys.current_gen(c, h.ns) == 1
+        assert swap_keys.read_plan(c, h.ns, 1) is None
+        assert swap_keys.read_boundary(c, h.ns, 1) == 0
+        c.close()
+        # never armed, never applied — and the trace conforms
+        events = _since_run_start(list(sess._flight.events()))
+        kinds = [e['kind'] for e in events
+                 if e['kind'].startswith('swap_')]
+        assert 'swap_stage' in kinds and 'swap_cancel' in kinds
+        assert 'swap_arm' not in kinds and 'swap_apply' not in kinds
+        assert swap_conformance.check_swap_events(events) == []
+        # the old plan still trains, on the unchanged trajectory
+        for _ in range(2):
+            sess.run(h.train_op, {h.x: h.feed})
+        np.testing.assert_allclose(
+            sess.get_variable_value('W'),
+            _ground_truth(h.W0, h.feed, steps + 2),
+            rtol=2e-4, atol=2e-5)
+    finally:
+        h.close()
+
+
+def test_swap_ack_timeout_cancels_and_retries(service, monkeypatch):
+    """The bounded ack window: a live peer that speaks no swap
+    protocol (never acks, never dies — so exclusion cannot shrink the
+    quorum) forces an ack_timeout cancel; the chief retries with
+    backoff under AUTODIST_SWAP_MAX_RETRIES, each retry staging a NEW
+    generation, then degrades to an audit-only entry with every staged
+    subtree withdrawn."""
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    monkeypatch.setenv('AUTODIST_EXECUTE_REPLAN', '1')
+    monkeypatch.setenv('AUTODIST_SWAP_ACK_TIMEOUT_S', '0.4')
+    monkeypatch.setenv('AUTODIST_SWAP_RETRY_BACKOFF_S', '0.1')
+    monkeypatch.setenv('AUTODIST_SWAP_MAX_RETRIES', '1')
+    h = _ChiefHarness(service)
+    try:
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_peer_loop,
+            args=(service, h.ns, 'p1', 10 ** 6, stop),
+            kwargs={'done_on_finish': False}, daemon=True)
+        t.start()
+        sess = h.create_session()
+        sess.run(h.train_op, {h.x: h.feed})
+        entry = sess.request_strategy_swap(sess._plan.strategy)
+        deadline = time.time() + 30.0
+        while time.time() < deadline and \
+                not entry.get('migration_skipped'):
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10.0)
+        assert entry.get('migration_skipped', '').endswith(
+            'ack_timeout'), entry
+        assert [c['gen'] for c in entry['swap_cancels']] == [1, 2]
+        assert all(c['reason'] == 'ack_timeout' and not c['nacks']
+                   for c in entry['swap_cancels'])
+        c = CoordClient(('127.0.0.1', service))
+        assert swap_keys.current_gen(c, h.ns) == 2
+        assert swap_keys.read_plan(c, h.ns, 1) is None
+        assert swap_keys.read_plan(c, h.ns, 2) is None
+        c.close()
+    finally:
+        h.close()
+
+
+def test_swap_delayed_ack_frame_still_converges(service, monkeypatch):
+    """The delay half of the matrix: a faultline delay_conn holds the
+    peer's ack SET on the wire; the ack lands late but inside the
+    bounded ack window, so the handshake completes on the FIRST
+    attempt — slow is not dead. The run-end purge then clears every
+    swap key (a restarted run starts from generation zero)."""
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    from autodist_tpu.utils.faultline import FaultLine, FaultPlan
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    monkeypatch.setenv('AUTODIST_EXECUTE_REPLAN', '1')
+    monkeypatch.setenv('AUTODIST_SWAP_ACK_TIMEOUT_S', '20')
+    monkeypatch.setenv('AUTODIST_SWAP_MAX_RETRIES', '0')
+    h = _ChiefHarness(service)
+    try:
+        plan = FaultPlan([{'kind': 'delay_conn',
+                           'match': 'SET %s/swap/1/ack/1' % h.ns,
+                           'at': 1, 'seconds': 1.0}], seed=19)
+        out = {}
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_swap_peer_loop,
+            args=(service, h.ns, None, out, stop), daemon=True)
+        with FaultLine(plan, worker='p1') as fl:
+            t.start()
+            sess = h.create_session()
+            for _ in range(2):
+                sess.run(h.train_op, {h.x: h.feed})
+            entry = sess.request_strategy_swap(sess._plan.strategy)
+            trained = 2
+            deadline = time.time() + 60.0
+            while time.time() < deadline and trained < 80:
+                sess.run(h.train_op, {h.x: h.feed})
+                trained += 1
+                if entry.get('migrated') or \
+                        entry.get('migration_error') or \
+                        entry.get('migration_skipped'):
+                    break
+        assert [e['kind'] for e in fl.events] == ['delay_conn']
+        assert entry.get('migrated') is True, entry
+        assert entry['swap']['gen'] == 1
+        assert entry['swap']['attempts'] == 1
+        assert 'swap_cancels' not in entry
+        stop.set()
+        t.join(timeout=10.0)
+        # run-end hygiene: close purges the whole swap namespace
+        sess.close()
+        c = CoordClient(('127.0.0.1', service))
+        assert swap_keys.current_gen(c, h.ns) == 0
+        assert swap_keys.read_plan(c, h.ns, 1) is None
+        c.close()
+    finally:
+        h.close()
+
+
+def test_restarted_run_never_sees_stale_staged_plan(service,
+                                                    monkeypatch):
+    """A crashed prior run's staged plan, armed boundary and
+    generation counter are swept by session init (swap_keys.purge_all
+    before the init rendezvous): the new cohort starts from generation
+    zero and can never validate — let alone apply — the dead run's
+    plan against its own step floors."""
+    from autodist_tpu.runtime import swap_keys
+    from autodist_tpu.runtime.coord_client import CoordClient
+    monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '0')
+    h = _ChiefHarness(service)
+    try:
+        c = CoordClient(('127.0.0.1', service))
+        # the dead run's leftovers, staged in the SAME namespace
+        swap_keys.stage_plan(c, h.ns, 3, 2, {'poison': True})
+        swap_keys.arm(c, h.ns, 3, 7)
+        assert swap_keys.current_gen(c, h.ns) == 3
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_peer_loop, args=(service, h.ns, 'p1', 3, stop),
+            kwargs={'done_on_finish': False}, daemon=True)
+        t.start()
+        h.create_session()
+        assert swap_keys.current_gen(c, h.ns) == 0
+        assert swap_keys.read_plan(c, h.ns, 3) is None
+        assert swap_keys.read_boundary(c, h.ns, 3) == 0
+        stop.set()
+        t.join(timeout=10.0)
+        c.close()
+    finally:
+        h.close()

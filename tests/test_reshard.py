@@ -172,23 +172,28 @@ def _free_port():
 
 @pytest.mark.skipif(not HAVE_GXX, reason='g++ unavailable')
 def test_executed_replan_migrates_live_session(monkeypatch):
-    """AUTODIST_EXECUTE_REPLAN: a live 2->3 worker re-plan migrates the
-    chief's session through the reshard path at a step boundary —
-    compiled steps drop, the plan swaps to the re-ranked PS-family
-    strategy, and the variable state is bit-exact with a run that
-    never migrated (values are moved, never recomputed)."""
+    """AUTODIST_EXECUTE_REPLAN: a live 2->3 worker re-plan runs the
+    epoch-swap handshake (stage -> peer ack quorum -> armed boundary)
+    and migrates the chief's session through the reshard path at the
+    commit boundary — compiled steps drop, the plan swaps to the
+    re-ranked PS-family strategy (re-keying now LEGAL under the
+    handshake), and the variable state is bit-exact with a run that
+    never migrated but trained the same number of steps (values are
+    moved, never recomputed)."""
     import autodist_tpu as ad
     from autodist_tpu.runtime.coord_client import (CoordClient,
                                                    ensure_service)
     from autodist_tpu.runtime.session import admit_worker
-    from autodist_tpu.utils.loose_harness import single_process_loose_env
+    from autodist_tpu.utils.loose_harness import (ack_staged_swaps,
+                                                  single_process_loose_env)
 
     port = _free_port()
     proc = ensure_service(port=port)
     monkeypatch.setenv('AUTODIST_PEER_FAILURE_POLICY', 'exclude')
     monkeypatch.setenv('AUTODIST_HEARTBEAT_TIMEOUT', '5.0')
 
-    def run_once(execute_replan, steps=5, join_at=1, dim=24):
+    def run_once(execute_replan, steps=5, train_total=None, join_at=1,
+                 dim=24):
         monkeypatch.setenv('AUTODIST_EXECUTE_REPLAN',
                            '1' if execute_replan else '0')
         with single_process_loose_env(port, depth=1):
@@ -210,6 +215,30 @@ def test_executed_replan_migrates_live_session(monkeypatch):
                 autodist._build()
                 ns = autodist._transformed[0].id
 
+                def drive(c, me, ordinal, start_step):
+                    """Publish steps; in the swap leg also speak the
+                    ack half of the handshake, publishing PAST the
+                    armed boundary so the chief's staleness gate never
+                    blocks its walk to step B."""
+                    seen, s = set(), start_step
+                    deadline = time.time() + 20.0
+                    while time.time() < deadline:
+                        s += 1
+                        c.heartbeat('%s/%s' % (ns, me))
+                        c.publish_step(me, s, prefix='%s/step/' % ns)
+                        if execute_replan:
+                            _, b = ack_staged_swaps(c, ns, ordinal,
+                                                    seen)
+                            if b and s >= b + 5:
+                                break
+                        elif s >= steps:
+                            break
+                        time.sleep(0.03)
+                    c.set('done/%s/%s' % (ns, me), '1')
+                    c.publish_step(me, 1 << 30,
+                                   prefix='%s/step/' % ns)
+                    c.close()
+
                 def peer():
                     c = CoordClient(('127.0.0.1', port))
                     gen = c.incr('fence/%s/p1' % ns, 0)
@@ -217,14 +246,7 @@ def test_executed_replan_migrates_live_session(monkeypatch):
                     c.heartbeat('%s/p1' % ns)
                     c.barrier('%s/session/init' % ns, 2,
                               timeout_s=60.0)
-                    for s in range(1, steps + 1):
-                        c.heartbeat('%s/p1' % ns)
-                        c.publish_step('p1', s, prefix='%s/step/' % ns)
-                        time.sleep(0.03)
-                    c.set('done/%s/p1' % ns, '1')
-                    c.publish_step('p1', 1 << 30,
-                                   prefix='%s/step/' % ns)
-                    c.close()
+                    drive(c, 'p1', 1, 0)
 
                 def joiner():
                     c = CoordClient(('127.0.0.1', port))
@@ -235,45 +257,50 @@ def test_executed_replan_migrates_live_session(monkeypatch):
                         time.sleep(0.02)
                     admit = admit_worker(c, ns)
                     me = admit['worker']
-                    for s in range(admit['adopted_step'] + 1,
-                                   steps + 1):
-                        c.heartbeat('%s/%s' % (ns, me))
-                        c.publish_step(me, s, prefix='%s/step/' % ns)
-                        time.sleep(0.03)
-                    c.set('done/%s/%s' % (ns, me), '1')
-                    c.publish_step(me, 1 << 30,
-                                   prefix='%s/step/' % ns)
-                    c.close()
+                    drive(c, me, int(me[1:]), admit['adopted_step'])
 
                 threads = [threading.Thread(target=peer, daemon=True),
                            threading.Thread(target=joiner, daemon=True)]
                 for t in threads:
                     t.start()
                 sess = autodist.create_distributed_session()
+                trained = 0
                 for _ in range(steps):
                     sess.run(train_op, {x: feed})
-                # the re-rank thread STAGES the migration; run() applies
-                # it at a step boundary — drive fetch-only runs (which
-                # also apply pending re-plans, and never mutate state)
-                # until it lands or the bounded wait expires
-                deadline = time.time() + 15.0
-                while execute_replan and time.time() < deadline:
-                    if any(r.get('migrated') or r.get('migration_error')
-                           for r in sess.health_stats.get('replans',
-                                                          [])):
-                        break
-                    sess.run(W)
-                    time.sleep(0.05)
+                    trained += 1
+                if execute_replan:
+                    # the re-rank thread stages the swap; the armed
+                    # boundary B lands at the start of a later step —
+                    # keep TRAINING (fetch-only runs never advance the
+                    # step counter, so they can never reach B) until
+                    # the migration lands or the bounded wait expires
+                    deadline = time.time() + 30.0
+                    while time.time() < deadline and trained < 60:
+                        if any(r.get('migrated')
+                               or r.get('migration_error')
+                               or r.get('migration_skipped')
+                               for r in sess.health_stats.get(
+                                   'replans', [])):
+                            break
+                        sess.run(train_op, {x: feed})
+                        trained += 1
+                else:
+                    # match the swap leg's step count exactly: the
+                    # bit-exactness claim is per-step
+                    for _ in range((train_total or steps) - trained):
+                        sess.run(train_op, {x: feed})
+                        trained += 1
                 w = sess.get_variable_value('W')
                 stats = dict(sess.health_stats)
                 sess.close()
                 for t in threads:
-                    t.join(timeout=15.0)
-        return np.asarray(w), stats
+                    t.join(timeout=25.0)
+        return np.asarray(w), stats, trained
 
     try:
-        w_plain, stats_plain = run_once(False)
-        w_mig, stats_mig = run_once(True)
+        w_mig, stats_mig, n_mig = run_once(True)
+        w_plain, stats_plain, n_plain = run_once(False,
+                                                 train_total=n_mig)
     finally:
         try:
             CoordClient(('127.0.0.1', port)).shutdown()
@@ -292,6 +319,10 @@ def test_executed_replan_migrates_live_session(monkeypatch):
     mig = migrated[0]['migration']
     assert mig['reshard']['vars'] >= 1
     assert mig['builder']
+    # the handshake audit trail: the entry records the armed boundary
+    swap = migrated[0].get('swap')
+    assert swap and swap['gen'] >= 1 and swap['boundary'] >= 1
     # the migration moved values, never recomputed them: final state
-    # is bit-exact with the never-migrated run
+    # is bit-exact with a never-migrated run of the same length
+    assert n_plain == n_mig
     assert np.abs(w_plain - w_mig).max() == 0.0

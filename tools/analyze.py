@@ -1,4 +1,4 @@
-"""Repo-wide static analysis CLI — one entry over the six analyzers.
+"""Repo-wide static analysis CLI — one entry over the seven analyzers.
 
     python tools/analyze.py --all            # everything, exit 0 = clean
     python tools/analyze.py --fence --env    # just those analyzers
@@ -17,10 +17,15 @@ docs/design/static-analysis.md):
               the tensor lock, the depth-2 pipeline's prefetch floor,
               the telemetry batch cursor (seeded: PR 1 offset-0
               abort, PR 5 disconnect wedge, PR 11 cursor race)
-  epoch-swap  the PROSPECTIVE strategy-distribution-epoch handshake
-              (ROADMAP 2): the verified stage->ack->arm->boundary
-              ordering explores clean, the tempting-but-wrong
-              orderings counterexample
+  epoch-swap  the strategy-distribution-epoch handshake model
+              (ROADMAP 2, implemented in PR 19): the verified
+              stage->ack->arm->boundary ordering explores clean, the
+              tempting-but-wrong orderings counterexample
+  swap-conformance
+              epoch-swap trace conformance: the synthetic verified
+              trace replays clean, seeded bad traces produce their
+              findings, and runtime/swap_keys.py's key schema pins to
+              the model's symbol table (spec<->impl drift guard)
   fence       coord_service.cc dispatcher fence-coverage + payload
               bounds + header table drift (absorbs
               tools/check_protocol.py)
@@ -63,17 +68,20 @@ os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 #: vanishing rather than as an incompatibility.
 SCHEMA_VERSION = 2
 
-ANALYZER_NAMES = ('protocol', 'data-plane', 'epoch-swap', 'fence',
-                  'env', 'schedule')
+ANALYZER_NAMES = ('protocol', 'data-plane', 'epoch-swap',
+                  'swap-conformance', 'fence', 'env', 'schedule')
 
 
 def _analyzers():
     from autodist_tpu.analysis import (data_plane_model, env_lint,
                                        epoch_swap_model, explore,
-                                       fence_lint, schedule_lint)
+                                       fence_lint, schedule_lint,
+                                       swap_conformance)
     # cheap lints first; the model checkers explore last
     return (('fence', fence_lint, fence_lint.analyze),
             ('env', env_lint, env_lint.analyze),
+            ('swap-conformance', swap_conformance,
+             swap_conformance.analyze),
             ('schedule', schedule_lint, schedule_lint.analyze),
             ('protocol', explore, explore.analyze),
             ('data-plane', data_plane_model, data_plane_model.analyze),
@@ -121,6 +129,11 @@ def main(argv=None):
                     dest='epoch_swap',
                     help='strategy-distribution-epoch handshake model '
                          '(the ROADMAP 2 contract)')
+    ap.add_argument('--swap-conformance', action='store_true',
+                    dest='swap_conformance',
+                    help='epoch-swap trace conformance: synthetic '
+                         'verified/seeded traces + key-schema pin '
+                         'against the model symbol table')
     ap.add_argument('--fence', action='store_true',
                     help='coord_service.cc fence-coverage + '
                          'payload-bound lint')

@@ -55,6 +55,22 @@ METRICS = (
      'elastic admit wall time'),
     ('elastic', 'extra.elastic.steps_blocked', 'lower',
      'steps blocked by the join'),
+    # the epoch-swap trajectory (PR 19): bytes_resharded is
+    # deterministic byte accounting of the re-key; downtime and
+    # steps-to-boundary are handshake-latency counters over one-shot
+    # thread-timed runs, so they carry the wide 5x scale. A
+    # state_max_abs_diff of -1 is the failure sentinel (the migration
+    # never landed); otherwise the moved-not-recomputed claim makes it
+    # exactly 0.0 and the zero-baseline epsilon catches the first
+    # divergent bit.
+    ('epoch_swap', 'extra.epoch_swap.bytes_resharded', 'lower',
+     'epoch-swap re-key wire bytes'),
+    ('epoch_swap', 'extra.epoch_swap.swap_downtime_steps', 'lower',
+     'steps stalled by the epoch swap', 5),
+    ('epoch_swap', 'extra.epoch_swap.steps_to_boundary', 'lower',
+     'epoch-swap request-to-boundary steps', 5),
+    ('epoch_swap', 'extra.epoch_swap.state_max_abs_diff', 'lower',
+     'epoch-swap final-state divergence vs control (-1 = no swap)'),
     ('ps_pipeline', 'extra.ps_pipeline.depth2.overlap_frac', 'higher',
      'PS pipeline depth-2 overlap fraction'),
     ('ps_pipeline', 'extra.ps_pipeline.depth2_speedup', 'higher',
