@@ -1,45 +1,52 @@
-"""Schedule/plan consistency lint.
+"""Schedule/plan consistency lint — shape algebra over the IR.
 
 The simulator prices the collective schedule
 ``static_collective_schedule`` derives WITHOUT tracing; the runtime
 emits the schedule ``ExecutionPlan.sync_gradients`` derives WHILE
-tracing. The two are pinned equal by a traced test on one fixture
-(``tests/test_simulator.py``), but a predicate edited in only one of
-them can drift on configurations the fixture does not cover — the
-cost model would then price a schedule the runtime never runs (the
-array-redistribution paper's core complaint about layout-move
-programs, arXiv:2112.01075). This lint cross-checks the EMISSION
-PREDICATES at the AST level, so any asymmetric edit fails tier-1
-regardless of fixture coverage:
+tracing. Since the schedule-IR refactor both derive from the SAME
+program (``schedule_ir.bucket_program`` builds it, ``schedule_entry``
+projects the static entry, ``execute`` drives the traced emission), so
+predicted == traced is structural and the old N per-predicate AST
+cross-checks collapse into two much stronger checks:
 
-- the bucket-fusion key (group, compressor, dtype, spec, hierarchical
-  knob, weight-update-sharding knob) must have identical canonical
-  components in both functions;
-- the fusable-predicate (which compressors may bucket-fuse, the
-  ``int8_bucket_fusable`` escape hatch) must admit the same set;
-- both sides must route the flat-vs-two-level choice through the ONE
-  shared ``choose_hierarchical`` decision with the same signature;
-- both sides must route the replicated-vs-sharded weight-update
-  choice through the ONE shared ``choose_update_sharding`` decision
-  with the same signature (traced: ``_wus_for``), and the
-  update-shard emissions must exist on both sides: the traced
-  reduce-scatter + bucketed param all-gather
-  (``_wus_scatter_bucket`` / ``gather_updated_params``) and the
-  static ``psum_scatter``/``all_gather`` pair tagged ``wus`` — an
-  asymmetric edit (e.g. new emission traced but never priced) fails
-  tier-1 here, not just on the fixture pin;
-- both sides must pack with ``pack_buckets`` and emit in the same
-  reverse-production order (the ``pending.sort`` key).
+- **IR shape algebra, run ONCE** (:func:`check_ir_algebra`): every
+  dimension combination the emitters can produce — flat vs two-level,
+  the int8 tier boundary, ZeRO scatter/gather halves, weight-update
+  sharding, sparse rows — is built through the shared lowering over
+  dividing, non-dividing and padded sizes and verified by
+  :func:`schedule_ir.verify`: groups partition the mesh, chunks tile
+  their spans, byte flow conserves across requantize boundaries, and
+  the final per-device partition matches the declared goal. A seeded
+  WRONG schedule (the int8 boundary requantize moved inside the ICI
+  phase) must still produce its finding — the same sensitivity guard
+  the model checkers carry (``analysis/explore.py`` SEEDED_BUGS): an
+  algebra that stops flagging the counterexample fails here, not
+  silently.
+- **a thin routes-through-the-IR drift check**
+  (:func:`check_emission_predicates`): both emitters must fuse through
+  the shared ``bucket_fusable`` / ``bucket_fusion_key`` predicates with
+  identical call shapes, pack via ``pack_buckets`` in the same
+  reverse-production order, lower through ``bucket_program`` +
+  ``schedule_entry``, and the traced side must EXECUTE through
+  ``schedule_ir.execute`` (an emission helper hand-rolling a collective
+  again would bypass everything the algebra proves). The shared
+  flat-vs-two-level (``choose_hierarchical``) and update-sharding
+  (``choose_update_sharding``) decisions must still be consulted on
+  both sides with the same call shape.
 
 Also here:
 
+- **pricing parity** (:func:`check_pricing_parity`):
+  ``cost_model.program_time`` over the lowered IR must agree with the
+  closed-form ``entry_time`` on the legacy shapes — the bridge that
+  lets synthesis rank hand-written and synthesized programs on one
+  scale;
 - **reshard shape algebra** — ``reshard.plan_reshard`` layout moves
-  are verified element-preserving over a synthetic geometry sweep
-  (every src/dst layout pair across dividing, non-dividing and padded
-  shapes): each op kind's preconditions hold (``all_to_all`` only on
-  clean unpadded axis changes, etc.), the destination layout's shards
-  partition exactly the logical element set (no loss, no overlap
-  outside the pad), and zero-wire kinds claim zero wire;
+  are verified over a synthetic geometry sweep (every src/dst layout
+  pair across dividing, non-dividing and padded shapes): op-kind
+  preconditions, destination-shard partition exactness, zero-wire
+  claims, AND each op's own IR program (``ReshardOp.ir_program``)
+  verifies clean through the same algebra the gradient schedules use;
 - the absorbed ``tools/check_wire_pricing.py`` drift check (compressor
   registry vs ``cost_model._WIRE_ITEMSIZE``).
 """
@@ -51,39 +58,88 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 PLAN_SRC = os.path.join(REPO, 'autodist_tpu', 'parallel', 'plan.py')
 
-# -- AST cross-check of the two emission paths ----------------------------
+# -- IR shape algebra (the ONE verification pass) -------------------------
 
-_CANON_RULES = (
-    (r'type\(plan\.compressor\)\.__name__', 'COMPRESSOR'),
-    (r'str\(np\.dtype\(var\.dtype\)\)', 'DTYPE'),
-    (r'str\(grad\.dtype\)', 'DTYPE'),
-    (r'plan\.group', 'GROUP'),
-    (r'plan\.spec', 'SPEC'),
-    (r'plan\.weight_update_sharding', 'WUS'),
-    (r'plan\.hierarchical', 'HIER'),
-)
+#: (kind, compressor, hier, wus) dimension combinations the emitters
+#: can produce — the five legacy schedule dimensions as IR lowerings.
+_IR_COMBOS = tuple(
+    [(kind, cname, hier, False)
+     for kind in ('all_reduce', 'psum_scatter', 'all_gather')
+     for cname in (None, 'HorovodCompressor', 'Int8RingCompressor')
+     for hier in (0, 2, 4)] +
+    [(kind, cname, hier, True)                 # weight-update sharding
+     for kind in ('psum_scatter', 'all_gather')
+     for cname in (None, 'Int8RingCompressor')
+     for hier in (0, 2)] +
+    [(kind, None, 0, False)                    # sparse rows
+     for kind in ('sparse_all_gather', 'sparse_scatter')])
+
+#: raw byte sizes: dividing (1024 f32 elems over 8 devices),
+#: non-dividing (1000 elems -> internal padding), and prime-odd.
+_IR_SIZES = (4096, 4000, 1972)
 
 
-def _canon(src, assigns):
-    """Whitespace-free source with single-assignment names substituted
-    and the known equivalent spellings mapped to canonical tokens."""
-    def rules(s):
-        for pat, token in _CANON_RULES:
-            s = re.sub(pat, token, s)
-        return s
+def check_ir_algebra(n=8):
+    """Build every emitter-reachable dimension combination through the
+    shared lowering and run the shape algebra on it. Any finding means
+    an emitter change produced a schedule that loses, duplicates or
+    mis-wires elements — caught structurally, regardless of fixture
+    coverage."""
+    from autodist_tpu.parallel import schedule_ir as sir
+    findings = []
+    for kind, cname, hier, wus in _IR_COMBOS:
+        for nbytes in _IR_SIZES:
+            try:
+                prog = sir.bucket_program(
+                    kind, nbytes, 'float32', cname, 'AUTO', n,
+                    hier=hier, wus=wus)
+            except ValueError as err:
+                findings.append(
+                    'schedule-ir lowering (%s, %s, hier=%d, wus=%s, '
+                    '%dB) refused to build: %s'
+                    % (kind, cname, hier, wus, nbytes, err))
+                continue
+            for f in sir.verify(prog):
+                findings.append('%s [from (%s, %s, hier=%d, wus=%s, '
+                                '%dB)]' % (f, kind, cname, hier, wus,
+                                           nbytes))
+    findings.extend(check_ir_sensitivity(n))
+    return findings
 
-    s = rules(re.sub(r'\s+', '', src))
-    for _ in range(4):   # bounded transitive substitution
-        out = s
-        for name, val in assigns.items():
-            out = re.sub(r'\b%s\b' % re.escape(name),
-                         lambda m, val=val: rules(val), out)
-        out = rules(out)
-        if out == s:
+
+def seeded_counterexample(n=8):
+    """A deliberately WRONG schedule: the int8 tier-boundary program
+    with its down-requantize moved INSIDE the ICI phase — the
+    reduce-scatter then declares an f32 wire while the live buffer is
+    already i8, exactly the mis-placed boundary the byte-flow /
+    wire-state rules exist to catch."""
+    from autodist_tpu.parallel import schedule_ir as sir
+    prog = sir.bucket_program('all_reduce', 1 << 16, 'float32',
+                              'Int8RingCompressor', 'AUTO', n, hier=2)
+    steps = list(prog.steps)
+    for i, s in enumerate(steps):
+        if s.op == 'requantize' and s.wire == 'i8' and i > 0:
+            steps[i - 1], steps[i] = steps[i], steps[i - 1]
             break
-        s = out
-    return s
+    return sir.Program(prog.name + '/seeded-bad', prog.n, prog.elems,
+                       prog.dtype, tuple(steps), prog.init, prog.goal,
+                       dict(prog.meta))
 
+
+def check_ir_sensitivity(n=8):
+    """The sensitivity guard: the seeded wrong schedule must still be
+    flagged, or the algebra's clean HEAD run proves nothing."""
+    from autodist_tpu.parallel import schedule_ir as sir
+    bad = seeded_counterexample(n)
+    if not sir.verify(bad):
+        return ['schedule-ir sensitivity guard: the seeded wrong '
+                'schedule (int8 requantize inside the ICI phase) '
+                'verifies CLEAN — the algebra lost the sensitivity '
+                'that justifies trusting its clean HEAD run']
+    return []
+
+
+# -- thin routes-through-the-IR drift check -------------------------------
 
 def _functions(tree):
     out = {}
@@ -91,62 +147,6 @@ def _functions(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             out[node.name] = node
     return out
-
-
-def _assigns(fn, src):
-    """Simple single-target name assignments inside ``fn`` (for
-    substitution), by source text."""
-    out = {}
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name):
-            seg = ast.get_source_segment(src, node.value)
-            if seg is not None:
-                name = node.targets[0].id
-                # only keep names assigned once (no reliable value
-                # otherwise)
-                out[name] = None if name in out \
-                    else re.sub(r'\s+', '', seg)
-    return {k: v for k, v in out.items() if v is not None}
-
-
-def _fusion_key(fn, src):
-    """The canonical components of ``key = (...)`` in ``fn``."""
-    assigns = _assigns(fn, src)
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == 'key' \
-                and isinstance(node.value, ast.Tuple):
-            return tuple(
-                _canon(ast.get_source_segment(src, el), assigns)
-                for el in node.value.elts)
-    return None
-
-
-def _fusable_compressors(fn, src):
-    """The compressor classes the ``type(plan.compressor) in (...)``
-    membership test admits, plus whether ``int8_bucket_fusable`` is
-    consulted."""
-    admitted, int8 = None, False
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
-                isinstance(node.ops[0], ast.In):
-            seg = re.sub(r'\s+', '',
-                         ast.get_source_segment(src, node.left) or '')
-            if seg == 'type(plan.compressor)' and \
-                    isinstance(node.comparators[0], ast.Tuple):
-                admitted = tuple(sorted(
-                    (ast.get_source_segment(src, el) or '')
-                    .split('.')[-1]
-                    for el in node.comparators[0].elts))
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = f.attr if isinstance(f, ast.Attribute) else \
-                getattr(f, 'id', '')
-            if name == 'int8_bucket_fusable':
-                int8 = True
-    return admitted, int8
 
 
 def _calls_of(fn, src, callee):
@@ -181,8 +181,18 @@ def _sort_key(fn, src):
     return None
 
 
+#: traced-emission helpers that must EXECUTE through the IR — a helper
+#: dispatching a collective without ``schedule_ir.execute`` bypasses
+#: the algebra, the pricing bridge and the entry-id join at once.
+_TRACED_EXECUTORS = ('_reduce_fn', '_capped_psum_scatter',
+                     '_int8_bucket_reduce', '_wus_scatter_bucket',
+                     'gather_updated_params')
+
+
 def check_emission_predicates(src=None):
-    """Cross-check sync_gradients vs static_collective_schedule."""
+    """Cross-check that sync_gradients and static_collective_schedule
+    both route through the ONE shared IR lowering (and the shared
+    fusion / hierarchy / update-sharding decisions)."""
     if src is None:
         with open(PLAN_SRC) as f:
             src = f.read()
@@ -195,30 +205,53 @@ def check_emission_predicates(src=None):
         return ['plan.py: sync_gradients/static_collective_schedule '
                 'not found — update analysis/schedule_lint.py for the '
                 'new layout']
-    tk, sk = _fusion_key(traced, src), _fusion_key(static, src)
-    if tk is None or sk is None:
-        findings.append('plan.py: bucket-fusion key tuple not found in '
-                        '%s' % ('sync_gradients' if tk is None
-                                else 'static_collective_schedule'))
-    elif tk != sk:
+    # the shared fusion predicates: both sides must consult the same
+    # bucket_fusable / bucket_fusion_key with the same call shape
+    for callee, what in (('bucket_fusable', 'fusable predicate'),
+                         ('bucket_fusion_key', 'fusion key')):
+        tc = _calls_of(traced, src, callee)
+        sc = _calls_of(static, src, callee)
+        if not tc or not sc:
+            findings.append(
+                'plan.py: the bucket %s must route through the ONE '
+                'shared %s on both sides (traced call missing: %s, '
+                'static call missing: %s) — an inline predicate '
+                'reintroduces exactly the per-side drift the IR '
+                'refactor removed' % (what, callee, not tc, not sc))
+        elif set(tc) != set(sc):
+            findings.append(
+                'plan.py: %s call shapes DRIFTED — traced %s vs '
+                'static %s: the simulator would price buckets the '
+                'runtime never emits' % (callee, tc, sc))
+    # the shared lowering: both sides must build programs via
+    # bucket_program and project entries via schedule_entry
+    for name, fn in (('sync_gradients', traced),
+                     ('static_collective_schedule', static)):
+        for callee in ('pack_buckets', 'bucket_program',
+                       'schedule_entry'):
+            if not _calls_of(fn, src, callee):
+                findings.append(
+                    'plan.py: %s no longer routes through %s — the '
+                    'two emission paths must derive from the SAME IR '
+                    'program' % (name, callee))
+    # the traced side must EXECUTE through the IR interpreter
+    for helper in _TRACED_EXECUTORS:
+        fn = fns.get(helper)
+        if fn is None:
+            findings.append(
+                'plan.py: traced emission helper %s missing — the '
+                'schedule the simulator prices no longer exists'
+                % helper)
+        elif not _calls_of(fn, src, 'execute'):
+            findings.append(
+                'plan.py: %s no longer executes through '
+                'schedule_ir.execute — a hand-rolled collective '
+                'bypasses the verified lowering' % helper)
+    if not _calls_of(traced, src, '_wus_scatter_bucket'):
         findings.append(
-            'plan.py: bucket-fusion keys DRIFTED — sync_gradients '
-            'fuses on %s but static_collective_schedule on %s: the '
-            'simulator would price buckets the runtime never emits'
-            % (tk, sk))
-    (ta, ti), (sa, si) = (_fusable_compressors(traced, src),
-                          _fusable_compressors(static, src))
-    if ta is None or sa is None:
-        findings.append(
-            'plan.py: fusable-compressor membership test '
-            '(type(plan.compressor) in (...)) not found in %s'
-            % ('sync_gradients' if ta is None
-               else 'static_collective_schedule'))
-    elif (ta, ti) != (sa, si):
-        findings.append(
-            'plan.py: fusable predicates DRIFTED — sync_gradients '
-            'admits %s (int8 hatch: %s) but static_collective_schedule '
-            'admits %s (int8 hatch: %s)' % (ta, ti, sa, si))
+            'plan.py: sync_gradients no longer dispatches '
+            'update-sharded buckets through _wus_scatter_bucket')
+    # shared flat-vs-two-level decision
     traced_hier = _calls_of(hier, src, 'choose_hierarchical') \
         if hier is not None else []
     static_hier = _calls_of(static, src, 'choose_hierarchical')
@@ -234,16 +267,12 @@ def check_emission_predicates(src=None):
             '%s vs static %s (same positional arity + kwargs required, '
             'or the two sides price different decisions)'
             % (traced_hier, static_hier))
-    # weight-update sharding: ONE shared decision + both emission
-    # halves present on both sides (the extension this lint grew for:
-    # an update-shard/all-gather emission edited on one side only must
-    # fail tier-1 regardless of fixture coverage)
+    # shared update-sharding decision; an emission that never CONSULTS
+    # the helper decides nothing
     wus_helper = fns.get('_wus_for')
     traced_wus = _calls_of(wus_helper, src, 'choose_update_sharding') \
         if wus_helper is not None else []
     if not _calls_of(traced, src, '_wus_for'):
-        # the helper may still carry the shared call, but an emission
-        # that never CONSULTS it decides nothing
         traced_wus = []
     static_wus = _calls_of(static, src, 'choose_update_sharding')
     if not traced_wus or not static_wus:
@@ -260,49 +289,63 @@ def check_emission_predicates(src=None):
             'required, or the slot placement, traced emission and '
             'priced schedule decide differently)'
             % (traced_wus, static_wus))
-    scatter_fn = fns.get('_wus_scatter_bucket')
-    gather_fn = fns.get('gather_updated_params')
-    if scatter_fn is None or gather_fn is None:
-        findings.append(
-            'plan.py: weight-update-shard emission halves missing '
-            '(_wus_scatter_bucket: %s, gather_updated_params: %s) — '
-            'the schedule the simulator prices no longer exists'
-            % (scatter_fn is None, gather_fn is None))
-    else:
-        if not _calls_of(traced, src, '_wus_scatter_bucket'):
-            findings.append(
-                'plan.py: sync_gradients no longer dispatches '
-                'update-sharded buckets through _wus_scatter_bucket')
-        if not (_calls_of(gather_fn, src, 'all_gather') or
-                _calls_of(gather_fn, src, 'hierarchical_all_gather')):
-            findings.append(
-                'plan.py: gather_updated_params no longer emits the '
-                'bucketed param all-gather')
+    # the static update-shard pair must survive as IR lowerings
     static_src = re.sub(r'\s+', '',
                         ast.get_source_segment(src, static) or '')
     for token, what in (
-            ("('psum_scatter','grad')",
-             'grad-phase reduce-scatter'),
-            ("('all_gather','param')",
-             'param-phase all-gather'),
-            ("'wus':True", 'wus tag')):
+            ("('psum_scatter','grad')", 'grad-phase reduce-scatter'),
+            ("('all_gather','param')", 'param-phase all-gather'),
+            ('wus=True', 'wus tag')):
         if token not in static_src:
             findings.append(
                 'plan.py: static_collective_schedule no longer emits '
                 'the update-shard %s entry (%s) — the simulator would '
                 'price a schedule without the update-sharding halves'
                 % (what, token))
-    for name, fn in (('sync_gradients', traced),
-                     ('static_collective_schedule', static)):
-        if not _calls_of(fn, src, 'pack_buckets'):
-            findings.append('plan.py: %s no longer packs via '
-                            'pack_buckets' % name)
     tso, sso = _sort_key(traced, src), _sort_key(static, src)
     if tso != sso:
         findings.append(
             'plan.py: bucket emission order DRIFTED — sync_gradients '
             'sorts by %r, static_collective_schedule by %r' % (tso,
                                                                sso))
+    return findings
+
+
+# -- pricing parity: program_time over the IR == entry_time ---------------
+
+def check_pricing_parity(n=8, nodes=2):
+    """``cost_model.program_time`` over the lowered IR must agree with
+    the closed-form ``entry_time`` on every legacy shape — the scale
+    synthesis ranks hand-written and synthesized candidates on."""
+    from autodist_tpu.parallel import schedule_ir as sir
+    from autodist_tpu.simulator import cost_model
+    params = cost_model.CostModelParams()
+    findings = []
+    shapes = [('all_reduce', None, 0), ('all_reduce', None, nodes),
+              ('all_reduce', 'HorovodCompressor', 0),
+              ('all_reduce', 'Int8RingCompressor', 0),
+              ('all_reduce', 'Int8RingCompressor', nodes),
+              ('psum_scatter', None, 0), ('psum_scatter', None, nodes),
+              ('all_gather', None, 0), ('all_gather', None, nodes),
+              ('sparse_all_gather', None, 0)]
+    for kind, cname, hier in shapes:
+        nbytes = 1 << 16
+        entry = {'kind': kind, 'bytes': nbytes, 'dtype': 'float32',
+                 'compressor': cname, 'spec': 'AUTO', 'vars': 1,
+                 'hier': hier, 'members': ['v']}
+        want, _ = cost_model.entry_time(entry, n, params,
+                                        cross_node=True)
+        prog = sir.bucket_program(kind, nbytes, 'float32', cname,
+                                  'AUTO', n, hier=hier)
+        got = cost_model.program_time(prog, params)
+        tol = max(1e-12, 1e-6 * abs(want))
+        if abs(got - want) > tol:
+            findings.append(
+                'pricing parity DRIFTED for (%s, %s, hier=%d): '
+                'program_time %.6g s vs entry_time %.6g s — synthesis '
+                'would rank hand-written schedules on a different '
+                'scale than the simulator prices them'
+                % (kind, cname, hier, got, want))
     return findings
 
 
@@ -352,9 +395,13 @@ def _mock_plan(shape, layout, n):
 
 
 def check_reshard_algebra():
-    """Element-preservation + op-kind preconditions over the sweep."""
+    """Element-preservation + op-kind preconditions over the sweep,
+    with every planned op ALSO verified through its own IR program —
+    reshard and gradient sync now answer to the same algebra."""
     from autodist_tpu.parallel import reshard
+    from autodist_tpu.parallel import schedule_ir as sir
     from autodist_tpu.simulator.cost_model import CostModelParams
+    import numpy as np
     params = CostModelParams()
     findings = []
     shapes = [(8,), (8, 4), (9, 4), (8, 6), (6, 10)]
@@ -375,6 +422,9 @@ def check_reshard_algebra():
                         shape, n, _fmt(src), _fmt(dst), op.kind)
                     findings.extend(_check_op(op, src, dst, shape, n,
                                               ctx))
+                    elems = int(np.prod(shape))
+                    for f in sir.verify(op.ir_program(n, elems)):
+                        findings.append('%s: %s' % (ctx, f))
     return findings
 
 
@@ -468,5 +518,6 @@ def check_wire_pricing():
 def analyze():
     """Run all schedule/plan consistency checks. Returns finding
     strings (empty = clean)."""
-    return (check_emission_predicates() + check_reshard_algebra() +
+    return (check_ir_algebra() + check_emission_predicates() +
+            check_pricing_parity() + check_reshard_algebra() +
             check_wire_pricing())

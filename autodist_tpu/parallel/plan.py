@@ -34,6 +34,7 @@ from autodist_tpu.const import (AXIS_DATA, BUCKET_BYTES_PER_CHUNK,
 from autodist_tpu.kernels.partitioner import PartitionerConfig
 from autodist_tpu.telemetry import core as _telemetry
 from autodist_tpu.parallel import compressor as comp
+from autodist_tpu.parallel import schedule_ir as sir
 from autodist_tpu.strategy.base import (AllReduceSynchronizer,
                                         PSSynchronizer)
 from autodist_tpu.utils import logging
@@ -226,6 +227,32 @@ def pack_buckets(items, cap_bytes, max_vars=0):
     return buckets
 
 
+def bucket_fusable(plan, dtype, size):
+    """THE per-variable admission predicate for fused AR buckets,
+    shared verbatim by the traced emitter (``sync_gradients``) and the
+    static mirror (``static_collective_schedule``): same-group
+    AllReduce vars whose compressor is stateless on the bucket wire
+    (none / bf16 cast) or whose int8 error-feedback state admits
+    bucket-level residuals (``compressor.int8_bucket_fusable``)."""
+    return bool(plan.is_ar and plan.group is not None and
+                (type(plan.compressor) in (comp.NoneCompressor,
+                                           comp.HorovodCompressor) or
+                 comp.int8_bucket_fusable(plan.compressor, dtype,
+                                          size)))
+
+
+def bucket_fusion_key(plan, dtype):
+    """THE bucket-fusion identity: variables may share a bucket only
+    when every field that changes the emitted collective agrees —
+    group, compressor, dtype, spec, and the two per-bucket schedule
+    knobs (hierarchical, weight-update sharding). Both emitters key
+    their packing off this tuple, so the traced and static bucket
+    layouts cannot drift."""
+    return (plan.group, type(plan.compressor).__name__,
+            str(jnp.dtype(dtype)), plan.spec, plan.hierarchical,
+            plan.weight_update_sharding)
+
+
 def _emit_bucket_tag(entry):
     """Telemetry tag for one emitted sync bucket (trace-time, so this
     fires once per compiled step, not per executed step): schedule
@@ -286,7 +313,8 @@ def assign_entry_ids(entries, counts=None):
 
 def static_collective_schedule(strategy, graph_item, num_replicas,
                                sparse_lookups_per_replica=4096,
-                               nodes=1, params=None):
+                               nodes=1, params=None,
+                               hier_fallback=None):
     """Static mirror of :meth:`ExecutionPlan.sync_gradients`'s emission.
 
     Computes, WITHOUT tracing a step, the per-step collective schedule a
@@ -321,6 +349,15 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
     (embedding) vars
     assume ``sparse_lookups_per_replica`` looked-up rows per step, the
     runtime's data-dependent quantity.
+
+    Every entry is DERIVED from the schedule IR: the same
+    ``schedule_ir.bucket_program`` lowering the traced emission
+    executes produces the entry via ``schedule_ir.schedule_entry``, so
+    predicted==traced is structural rather than test-pinned. When the
+    caller's host layout forced the hierarchical fallback
+    (``cost_model.num_node_groups_with_reason``), ``hier_fallback``
+    carries the reason and rides every flat comm entry, so a priced
+    flat win stays distinguishable from a layout degrade.
     """
     import numpy as np
 
@@ -369,10 +406,16 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
 
     def entry(kind, plan, nbytes, members, phase='grad', vars_=1,
               group=None, compressor=None, hier=0):
-        return {'kind': kind, 'group': group, 'compressor': compressor,
-                'dtype': str(np.dtype(plan.var.dtype)), 'spec': plan.spec,
-                'vars': vars_, 'bytes': int(nbytes), 'members': members,
-                'phase': phase, 'hier': hier, 'wus': False}
+        prog = sir.bucket_program(kind, nbytes,
+                                  str(np.dtype(plan.var.dtype)),
+                                  compressor, plan.spec, n, hier=hier)
+        e = sir.schedule_entry(prog, group=group, members=list(members),
+                               vars_=vars_, phase=phase)
+        # the legacy schema keeps the caller's literal compressor field
+        # (None for the un-grouped kinds) — the IR meta normalizes to
+        # registry names, which would change pinned entry ids
+        e['compressor'] = compressor
+        return e
 
     fusable = {}   # (group, compressor, dtype, spec, hier, wus) -> [idx]
     for i, (var, plan) in enumerate(zip(sources, plans)):
@@ -441,15 +484,9 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
                 and sparse_bytes < nbytes:
             entries.append(entry('sparse_all_gather', plan, sparse_bytes,
                                  [var.name]))
-        elif plan.is_ar and plan.group is not None and \
-                (type(plan.compressor) in (comp.NoneCompressor,
-                                           comp.HorovodCompressor) or
-                 comp.int8_bucket_fusable(plan.compressor, var.dtype,
-                                          size)):
-            key = (plan.group, cname, str(np.dtype(var.dtype)),
-                   plan.spec, plan.hierarchical,
-                   plan.weight_update_sharding)
-            fusable.setdefault(key, []).append(i)
+        elif bucket_fusable(plan, var.dtype, size):
+            fusable.setdefault(bucket_fusion_key(plan, var.dtype),
+                               []).append(i)
         else:
             entries.append(entry('all_reduce', plan, nbytes, [var.name],
                                  group=plan.group, compressor=cname))
@@ -492,23 +529,31 @@ def static_collective_schedule(strategy, graph_item, num_replicas,
             members = [sources[i].name for i in bucket]
             for kind, phase in (('psum_scatter', 'grad'),
                                 ('all_gather', 'param')):
-                entries.append({
-                    'kind': kind, 'group': group, 'compressor': cname,
-                    'dtype': dtype, 'spec': spec, 'vars': len(bucket),
-                    'bytes': wbytes, 'members': list(members),
-                    'phase': phase, 'hier': hier, 'wus': True})
+                prog = sir.bucket_program(kind, wbytes, dtype, cname,
+                                          spec, n, hier=hier, wus=True)
+                entries.append(sir.schedule_entry(
+                    prog, group=group, members=list(members),
+                    vars_=len(bucket), phase=phase))
             continue
         hier = 0
         if nodes > 1 and choose_hierarchical(
                 nbytes, dtype, cname, n, nodes, params,
                 knob=hknob, spec=spec):
             hier = nodes
-        entries.append({
-            'kind': 'all_reduce', 'group': group, 'compressor': cname,
-            'dtype': dtype, 'spec': spec, 'vars': len(bucket),
-            'bytes': nbytes,
-            'members': [sources[i].name for i in bucket],
-            'phase': 'grad', 'hier': hier, 'wus': False})
+        prog = sir.bucket_program('all_reduce', nbytes, dtype, cname,
+                                  spec, n, hier=hier)
+        entries.append(sir.schedule_entry(
+            prog, group=group,
+            members=[sources[i].name for i in bucket],
+            vars_=len(bucket), phase='grad'))
+    if hier_fallback:
+        # satellite of the unequal-host warning: the reason a flat
+        # schedule was forced (vs merely priced cheaper) rides every
+        # flat comm entry, joinable downstream by entry id
+        for e in entries:
+            if e['kind'] in ('all_reduce', 'psum_scatter',
+                             'all_gather') and not e.get('hier'):
+                e['hier_fallback'] = hier_fallback
     return assign_entry_ids(entries)
 
 
@@ -849,13 +894,22 @@ class ExecutionPlan:
 
     # -- gradient synchronization (runs inside shard_map) -----------------
     def _reduce_fn(self, spec, hier_groups=None):
+        """Mean-reduce callable for ONE collective, routed through the
+        schedule IR: the value's flat/two-level AR program lowers via
+        ``schedule_ir.execute`` to the exact legacy emission (pmean,
+        the forced ppermute ring, or the two-level composition) — one
+        invocation per emitted collective, which the bucketing tests'
+        reduce spy counts."""
         n = self.num_replicas
-        if hier_groups:
-            return lambda g: hierarchical_all_reduce(
-                g, AXIS_DATA, hier_groups) / n
-        if spec == 'RING':
-            return lambda g: ring_all_reduce(g, AXIS_DATA) / n
-        return lambda g: jax.lax.pmean(g, AXIS_DATA)
+        k = len(hier_groups) if hier_groups else 0
+
+        def fn(g):
+            prog = sir.bucket_program(
+                'all_reduce', g.size * jnp.dtype(g.dtype).itemsize,
+                str(g.dtype), None, spec, n, hier=k,
+                node_groups=hier_groups)
+            return sir.execute(prog, g, AXIS_DATA)
+        return fn
 
     def _hier_groups_for(self, nbytes, dtype, compressor_name, spec,
                          knob):
@@ -1028,17 +1082,13 @@ class ExecutionPlan:
             groups = self._hier_groups_for(int(nb), str(x.dtype),
                                            'NoneCompressor', plan.spec,
                                            plan.hierarchical)
-            self._record_entry({
-                'kind': 'psum_scatter', 'group': None,
-                'compressor': None, 'dtype': str(x.dtype),
-                'spec': plan.spec, 'vars': 1, 'bytes': int(nb),
-                'members': [plan.var.name],
-                'hier': len(groups) if groups else 0})
-            if groups:
-                return hierarchical_psum_scatter(
-                    x, AXIS_DATA, groups, axis=axis) / n
-            return jax.lax.psum_scatter(
-                x, AXIS_DATA, scatter_dimension=axis, tiled=True) / n
+            prog = sir.bucket_program(
+                'psum_scatter', int(nb), str(x.dtype), None, plan.spec,
+                n, hier=len(groups) if groups else 0,
+                node_groups=groups)
+            self._record_entry(sir.schedule_entry(
+                prog, members=[plan.var.name]))
+            return sir.execute(prog, x, AXIS_DATA, axis=axis)
 
         if nbytes <= cap or g.ndim < 2:
             return scatter(g, nbytes)
@@ -1099,15 +1149,9 @@ class ExecutionPlan:
                     sparse_bytes < grad.size):
                 out[i] = self._sparse_allreduce(grad, ids)
                 plan.sparse_synced = True
-            elif (plan.is_ar and plan.group is not None and
-                    (type(plan.compressor) in (comp.NoneCompressor,
-                                               comp.HorovodCompressor) or
-                     comp.int8_bucket_fusable(plan.compressor,
-                                              grad.dtype, grad.size))):
-                key = (plan.group, type(plan.compressor).__name__,
-                       str(grad.dtype), plan.spec, plan.hierarchical,
-                       plan.weight_update_sharding)
-                fusable.setdefault(key, []).append(i)
+            elif bucket_fusable(plan, grad.dtype, grad.size):
+                fusable.setdefault(bucket_fusion_key(plan, grad.dtype),
+                                   []).append(i)
             else:
                 out[i] = plan.compressor.reduce(
                     grad, env, self._reduce_fn(plan.spec))
@@ -1151,12 +1195,14 @@ class ExecutionPlan:
                 continue
             groups = self._hier_groups_for(nbytes, dtype, cname, spec,
                                            hknob)
-            self._record_entry({
-                'kind': 'all_reduce', 'group': group,
-                'compressor': cname, 'dtype': dtype, 'spec': spec,
-                'vars': len(bucket), 'bytes': nbytes,
-                'members': [sources[i].name for i in bucket],
-                'hier': len(groups) if groups else 0})
+            prog = sir.bucket_program(
+                'all_reduce', nbytes, dtype, cname, spec,
+                self.num_replicas, hier=len(groups) if groups else 0,
+                node_groups=groups)
+            self._record_entry(sir.schedule_entry(
+                prog, group=group,
+                members=[sources[i].name for i in bucket],
+                vars_=len(bucket)))
             if len(bucket) == 1 and groups is None:
                 i = bucket[0]
                 plan = self.plan_for(sources[i])
@@ -1167,7 +1213,8 @@ class ExecutionPlan:
             sizes = [f.shape[0] for f in flats]
             if cname == 'Int8RingCompressor':
                 buf = self._int8_bucket_reduce(bucket, sources, flats,
-                                               env, hier_groups=groups)
+                                               env, hier_groups=groups,
+                                               program=prog)
             else:
                 reduce_fn = self._reduce_fn(spec, hier_groups=groups) \
                     if groups else self._reduce_fn(spec)
@@ -1186,7 +1233,7 @@ class ExecutionPlan:
         return out
 
     def _int8_bucket_reduce(self, bucket, sources, flats, env,
-                            hier_groups=None):
+                            hier_groups=None, program=None):
         """Quantized-collective reduction of ONE packed bucket.
 
         The whole bucket is quantized as a single vector with per-block
@@ -1226,13 +1273,17 @@ class ExecutionPlan:
                 ).reshape(self.plan_for(sources[i]).var.shape)}
             offset += size
         n = self.num_replicas
-        if hier_groups:
-            # quantize once (the roundtrip above), requantize at the
-            # tier boundary: intra-node phases ride f32 ICI, only the
-            # cross-node chunk rides the int8 ring
-            return comp.int8_hierarchical_all_reduce(
-                transmitted, AXIS_DATA, hier_groups) / n
-        return comp.int8_ring_all_reduce(transmitted, AXIS_DATA) / n
+        if program is None:
+            program = sir.bucket_program(
+                'all_reduce',
+                int(buf.size * jnp.dtype(buf.dtype).itemsize),
+                str(buf.dtype), 'Int8RingCompressor', 'AUTO', n,
+                hier=len(hier_groups) if hier_groups else 0,
+                node_groups=hier_groups)
+        # quantize once (the roundtrip above), requantize at the tier
+        # boundary: the IR lowering dispatches the int8 ring (flat) or
+        # the f32-ICI / int8-DCN two-level composition
+        return sir.execute(program, transmitted, AXIS_DATA)
 
     def _wus_scatter_bucket(self, bucket, sources, grads, group, cname,
                             dtype, spec, hknob):
@@ -1261,24 +1312,19 @@ class ExecutionPlan:
         padded_bytes = int(buf.size * jnp.dtype(buf.dtype).itemsize)
         groups = self._hier_groups_for(padded_bytes, dtype, cname, spec,
                                        hknob)
-        if groups:
-            shard = hierarchical_psum_scatter(buf, AXIS_DATA,
-                                              groups) / n
-        else:
-            shard = jax.lax.psum_scatter(buf, AXIS_DATA,
-                                         scatter_dimension=0,
-                                         tiled=True) / n
+        prog = sir.bucket_program(
+            'psum_scatter', padded_bytes, dtype, cname, spec, n,
+            hier=len(groups) if groups else 0, wus=True,
+            node_groups=groups)
+        shard = sir.execute(prog, buf, AXIS_DATA)
         meta = {'members': [sources[i].name for i in bucket],
                 'shard_sizes': shard_sizes,
                 'hier_groups': groups,
                 'group': group, 'compressor': cname, 'dtype': dtype,
                 'spec': spec, 'bytes': padded_bytes}
-        self._record_entry({
-            'kind': 'psum_scatter', 'group': group,
-            'compressor': cname, 'dtype': dtype, 'spec': spec,
-            'vars': len(bucket), 'bytes': padded_bytes,
-            'members': list(meta['members']),
-            'hier': len(groups) if groups else 0, 'wus': True})
+        self._record_entry(sir.schedule_entry(
+            prog, group=group, members=list(meta['members']),
+            vars_=len(bucket)))
         out, off = [], 0
         for pos, (i, m) in enumerate(zip(bucket, shard_sizes)):
             out.append((i, UpdateShard(shard[off:off + m], self,
@@ -1307,33 +1353,31 @@ class ExecutionPlan:
             buckets.setdefault(id(sh.meta), (sh.meta, {}))[1][name] = sh
         for meta, members in buckets.values():
             names = meta['members']
+            hier = len(meta['hier_groups']) if meta['hier_groups'] \
+                else 0
             if set(names) != set(members):
                 for name, sh in members.items():
                     out[name] = sh.gather()
-                    self._record_entry({
-                        'kind': 'all_gather', 'group': meta['group'],
-                        'compressor': meta['compressor'],
-                        'dtype': meta['dtype'], 'spec': meta['spec'],
-                        'vars': 1,
-                        'bytes': sh.shard_size * self.num_replicas *
+                    mprog = sir.bucket_program(
+                        'all_gather',
+                        sh.shard_size * self.num_replicas *
                         jnp.dtype(sh.value.dtype).itemsize,
-                        'members': [name],
-                        'hier': len(meta['hier_groups'])
-                        if meta['hier_groups'] else 0, 'wus': True})
+                        meta['dtype'], meta['compressor'],
+                        meta['spec'], self.num_replicas, hier=hier,
+                        wus=True, node_groups=meta['hier_groups'])
+                    self._record_entry(sir.schedule_entry(
+                        mprog, group=meta['group'], members=[name]))
                 continue
             cat = jnp.concatenate([members[nm].value for nm in names])
             groups = meta['hier_groups']
-            if groups:
-                full = hierarchical_all_gather(cat, AXIS_DATA, groups)
-            else:
-                full = jax.lax.all_gather(cat, AXIS_DATA, tiled=True)
-            self._record_entry({
-                'kind': 'all_gather', 'group': meta['group'],
-                'compressor': meta['compressor'],
-                'dtype': meta['dtype'], 'spec': meta['spec'],
-                'vars': len(names), 'bytes': meta['bytes'],
-                'members': list(names),
-                'hier': len(groups) if groups else 0, 'wus': True})
+            prog = sir.bucket_program(
+                'all_gather', meta['bytes'], meta['dtype'],
+                meta['compressor'], meta['spec'], self.num_replicas,
+                hier=hier, wus=True, node_groups=groups)
+            full = sir.execute(prog, cat, AXIS_DATA)
+            self._record_entry(sir.schedule_entry(
+                prog, group=meta['group'], members=list(names),
+                vars_=len(names)))
             mat = full.reshape(self.num_replicas, -1)
             off = 0
             for nm, m in zip(names, meta['shard_sizes']):

@@ -74,6 +74,78 @@ class ReshardOp:
     def to_dict(self):
         return asdict(self)
 
+    def ir_program(self, n, elems, dtype='float32'):
+        """This move as a :mod:`~autodist_tpu.parallel.schedule_ir`
+        program — the same IR gradient syncs lower through, so the
+        shape algebra verifies reshards too (``tools/analyze.py
+        --schedule`` runs it). Element space is the flattened padded
+        physical array in the DESTINATION coordinate frame; every path
+        is pure data movement, so holdings carry full-value (ALL-
+        contrib) fragments and the algebra checks coverage, never
+        reduction completeness. ``ReshardOp`` stores layouts only, so
+        the caller supplies the mesh size ``n`` and the physical
+        element count ``elems``. Chaining ``run_algebra`` holdings
+        through consecutive programs proves A -> B -> A identity
+        (``tests/test_schedule_ir.py`` pins it)."""
+        from autodist_tpu.parallel import schedule_ir as sir
+        n = int(n)
+        wire = sir.wire_of_dtype(dtype)
+        meta = {'reshard': self.kind, 'var': self.var_name}
+        name = 'reshard_%s_%s' % (self.kind, self.var_name)
+        full = (tuple(range(n)),)
+        if self.kind == 'noop':
+            state = 'value_sharded' if self.src.get('sharded') \
+                else 'value_replicated'
+            E = sir._pad_to(elems, n) if state == 'value_sharded' \
+                else int(elems)
+            return sir.Program(name, n, E, str(dtype), (), state,
+                               state, meta)
+        E = sir._pad_to(elems, n)
+        m = E // n
+        chunks = (tuple((d * m, (d + 1) * m) for d in range(n)),)
+        if self.kind == 'shard':
+            # replicated -> sharded: zero-wire local projection; the
+            # algebra checks each device already covers its chunk.
+            steps = (sir.Step('scatter', tier='local', wire=wire,
+                              groups=full, chunks=chunks),)
+            return sir.Program(name, n, E, str(dtype), steps,
+                               'value_replicated', 'value_sharded',
+                               meta)
+        if self.kind == 'all_gather':
+            steps = (sir.Step('all_gather', tier='dcn', wire=wire,
+                              groups=full, span=((0, E),),
+                              nbytes=sir.wire_nbytes(E, wire)),)
+            return sir.Program(name, n, E, str(dtype), steps,
+                               'value_sharded', 'value_replicated',
+                               meta)
+        if self.kind == 'all_to_all':
+            # sharded(a) -> sharded(b): in the destination frame each
+            # source shard is the block transpose — device d holds one
+            # mm-slice of every destination chunk — and one wired
+            # scatter redistributes them into contiguous chunks.
+            E = sir._pad_to(elems, n * n)
+            m = E // n
+            mm = m // n
+            ALL = frozenset(range(n))
+            init = [[(j * m + d * mm, j * m + (d + 1) * mm, ALL)
+                     for j in range(n)] for d in range(n)]
+            chunks = (tuple((d * m, (d + 1) * m) for d in range(n)),)
+            nb = (n - 1) / float(max(1, n)) * \
+                sir.wire_nbytes(E, wire) or 1.0
+            steps = (sir.Step('scatter', tier='dcn', wire=wire,
+                              groups=full, chunks=chunks, nbytes=nb),)
+            return sir.Program(name, n, E, str(dtype), steps, init,
+                               'value_sharded', meta)
+        if self.kind == 'gather_scatter':
+            steps = (sir.Step('all_gather', tier='dcn', wire=wire,
+                              groups=full, span=((0, E),),
+                              nbytes=sir.wire_nbytes(E, wire)),
+                     sir.Step('scatter', tier='local', wire=wire,
+                              groups=full, chunks=chunks))
+            return sir.Program(name, n, E, str(dtype), steps,
+                               'value_sharded', 'value_sharded', meta)
+        raise ValueError('Unknown reshard kind %r' % (self.kind,))
+
 
 def _move_cost(kind, nbytes, n, params):
     """Redistribution cost-model estimate for one move of ``nbytes``

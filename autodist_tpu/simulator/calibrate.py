@@ -170,6 +170,27 @@ def fit_alpha_beta(samples, num_replicas):
     return float(max(alpha, 0.0)), float(beta)
 
 
+def tier_links(params, host_scale=None):
+    """Per-tier ``{tier: (alpha, beta)}`` for schedule-IR pricing
+    (:func:`cost_model.program_time`'s ``links`` argument). The ICI
+    and DCN tiers come straight from ``params`` — calibrated constants
+    when a fit ran, analytic otherwise. The intermediate ``host`` tier
+    (cross-host but intra-slice; no legacy schedule runs collectives
+    there, so nothing calibrates it directly) defaults to the
+    geometric mean of the two measured tiers — the standard
+    interpolation for an unmeasured middle link — or to
+    ``host_scale`` × the ICI constants when the caller knows the
+    ratio."""
+    ai, bi = params.link(cross_node=False)
+    ad, bd = params.link(cross_node=True)
+    if host_scale:
+        host = (ai * float(host_scale), bi * float(host_scale))
+    else:
+        host = ((ai * ad) ** 0.5, (bi * bd) ** 0.5)
+    return {'local': (0.0, 0.0), 'ici': (ai, bi), 'host': host,
+            'dcn': (ad, bd)}
+
+
 def samples_from_drift(table):
     """Entry-labeled ``(ici, dcn)`` sample lists from a roofline
     drift table (:func:`autodist_tpu.telemetry.roofline.drift_table`).

@@ -389,6 +389,64 @@ def choose_hierarchical(nbytes, dtype, compressor, n, nodes, params,
                              ici_bytes=ici_b) < flat
 
 
+#: fallback reasons already warned about this process — the decision
+#: is re-made per bucket, and one line per node SHAPE (not per call)
+#: is what an operator can read.
+_UNEQUAL_WARNED = set()
+
+
+def _warn_hier_fallback(reason):
+    if reason and reason not in _UNEQUAL_WARNED:
+        _UNEQUAL_WARNED.add(reason)
+        logging.warning('hierarchical schedule falls back to flat: %s',
+                        reason)
+
+
+def num_node_groups_with_reason(strategy=None, resource_spec=None,
+                                num_replicas=None):
+    """``(k, reason)``: the node-group count plus, when the host layout
+    forced the flat fallback, a one-line machine-readable reason naming
+    the node shape (e.g. ``unequal-hosts:hostA=4,hostB=2``). ``reason``
+    is None whenever the returned count is a genuine hierarchy (or the
+    mesh is single-host, where flat is not a degradation). The reason
+    rides the static schedule entries (``hier_fallback``) so a priced
+    flat win stays distinguishable from a layout that could not go
+    two-level — and :mod:`simulator.search` can still synthesize an
+    unequal-group IR schedule for exactly these shapes."""
+    from autodist_tpu.const import ENV
+    forced = ENV.AUTODIST_HIERARCHY_NODES.val
+    if forced and forced >= 2:
+        n = int(num_replicas or 0)
+        if n and n % forced == 0 and n // forced >= 2:
+            return forced, None
+        return 1, 'forced-nodes:%d does not split n=%d' % (forced, n)
+    hosts = []
+    replicas = list(strategy.graph_config.replicas) if strategy and \
+        strategy.graph_config.replicas else []
+    if replicas:
+        hosts = [d.rsplit(':', 2)[0] for d in replicas]
+    elif resource_spec is not None:
+        per_node = resource_spec.node_accelerator_devices or \
+            {a: [a] for a in resource_spec.nodes}
+        hosts = [h for h, devs in per_node.items() for _ in devs]
+    if not hosts:
+        return 1, None
+    counts = {}
+    for h in hosts:
+        counts[h] = counts.get(h, 0) + 1
+    k = len(counts)
+    n = int(num_replicas or len(hosts))
+    if k <= 1:
+        return 1, None
+    shape = ','.join('%s=%d' % (h, c) for h, c in counts.items())
+    if len(set(counts.values())) != 1:
+        return 1, 'unequal-hosts:%s' % shape
+    if n % k:
+        return 1, 'replicas:%d not divisible by hosts:%d (%s)' \
+            % (n, k, shape)
+    return k, None
+
+
 def num_node_groups(strategy=None, resource_spec=None, num_replicas=None):
     """Node-group count for hierarchical pricing: distinct hosts among
     the strategy's replica devices (the same host-major order the mesh
@@ -401,32 +459,13 @@ def num_node_groups(strategy=None, resource_spec=None, num_replicas=None):
     refuse to emit. The ``AUTODIST_HIERARCHY_NODES`` override takes
     the same precedence it does at trace time — under the override the
     emission groups by it regardless of the spec's host layout, and
-    pricing must describe the program that actually runs."""
-    from autodist_tpu.const import ENV
-    forced = ENV.AUTODIST_HIERARCHY_NODES.val
-    if forced and forced >= 2:
-        n = int(num_replicas or 0)
-        if n and n % forced == 0 and n // forced >= 2:
-            return forced
-        return 1
-    hosts = []
-    replicas = list(strategy.graph_config.replicas) if strategy and \
-        strategy.graph_config.replicas else []
-    if replicas:
-        hosts = [d.rsplit(':', 2)[0] for d in replicas]
-    elif resource_spec is not None:
-        per_node = resource_spec.node_accelerator_devices or \
-            {a: [a] for a in resource_spec.nodes}
-        hosts = [h for h, devs in per_node.items() for _ in devs]
-    if not hosts:
-        return 1
-    counts = {}
-    for h in hosts:
-        counts[h] = counts.get(h, 0) + 1
-    k = len(counts)
-    n = int(num_replicas or len(hosts))
-    if k <= 1 or n % k or len(set(counts.values())) != 1:
-        return 1
+    pricing must describe the program that actually runs. A silent
+    degrade is indistinguishable from a priced flat win, so the flat
+    fallback logs a one-line warning naming the node shape (once per
+    shape; :func:`num_node_groups_with_reason` exposes the reason)."""
+    k, reason = num_node_groups_with_reason(strategy, resource_spec,
+                                            num_replicas)
+    _warn_hier_fallback(reason)
     return k
 
 
@@ -466,6 +505,116 @@ def entry_time(e, n, params, cross_node=False):
         # ring's per-hop requantization — extra HBM passes
         t += e['bytes'] * params.quant_s_per_byte
     return t, wb
+
+
+#: schedule-IR tier ladder, fastest link first (mirrors
+#: parallel.schedule_ir.TIER_ORDER — kept local to avoid importing the
+#: IR module at pricing time).
+_IR_TIER_ORDER = {'local': 0, 'ici': 1, 'host': 2, 'dcn': 3}
+
+
+def program_links(params, links=None):
+    """Per-tier ``(α, β)`` link constants for :func:`program_time`.
+
+    Two-link topologies map the IR's four tiers onto the calibrated
+    pair: ``ici`` rides the fast link, ``host`` and ``dcn`` the slow
+    one, ``local`` is free. A 3-level topology (distinct host- and
+    slice-crossing links) passes ``links`` overrides per tier —
+    :class:`simulator.search.ScheduleTopo` carries them."""
+    out = {'local': (0.0, 0.0),
+           'ici': params.link(cross_node=False),
+           'host': params.link(cross_node=True),
+           'dcn': params.link(cross_node=True)}
+    if links:
+        out.update(links)
+    return out
+
+
+def program_time(program, params, links=None, per_step=False):
+    """Predicted seconds for a schedule-IR :class:`Program`, priced
+    per step from the SAME α-β constants :func:`entry_time` uses —
+    for the hand-written shapes (flat ring, equal two-level, the
+    ZeRO/WUS halves) this reproduces :func:`collective_time` /
+    :func:`hierarchical_time` / :func:`hierarchical_half_time`
+    exactly, which is what lets synthesized programs rank against
+    legacy entries on one scale.
+
+    Per comm step the time is the MAX over its device groups (groups
+    run concurrently; the straggler group of an unequal split sets the
+    step's pace — waves are separate steps and sum sequentially).
+    Each adjacent pair of comm steps on DIFFERENT tiers charges half a
+    tier-boundary re-layout pass (``hier_boundary_s_per_byte`` on the
+    faster-tier step's bytes — two transitions recover the full
+    boundary term of :func:`hierarchical_time`). Requantize steps
+    charge the cast HBM passes (plus the quantization passes when an
+    int8 wire is involved) at half the per-entry rate each, so a
+    down+up pair prices exactly like the compressor charges in
+    :func:`entry_time`.
+
+    ``per_step=True`` returns ``(total, [seconds per comm step])`` —
+    the list excludes the boundary/requantize overheads (they are
+    between-step costs), so ``total >= sum(list)``.
+    """
+    link = program_links(params, links)
+    times = []
+    total = 0.0
+    prev_tier = None
+    prev_nbytes = 0.0
+    cur_wire = None
+    raw = float(program.meta.get('raw_bytes') or
+                program.elems * np.dtype(program.dtype).itemsize)
+    for s in program.steps:
+        if s.op == 'requantize':
+            extra = 0.5 * raw * params.compress_s_per_byte
+            if 'i8' in (s.wire, cur_wire):
+                extra += 0.5 * raw * params.quant_s_per_byte
+            total += extra
+            cur_wire = s.wire
+            continue
+        if s.op not in ('reduce_scatter', 'all_reduce', 'all_gather'):
+            continue
+        alpha, beta = link[s.tier]
+        factor = 2.0 if s.op == 'all_reduce' else 1.0
+        t = 0.0
+        for g in s.groups:
+            gs = len(g)
+            if gs <= 1:
+                continue
+            t = max(t, factor * (gs - 1) * alpha +
+                    factor * (gs - 1) / gs * float(s.nbytes) * beta)
+        if prev_tier is not None and s.tier != prev_tier:
+            # tier boundary: half a re-layout HBM pass per crossing,
+            # charged on the faster tier's payload (the buffer that
+            # gets re-laid-out lives at the fast tier's width)
+            fast = s.nbytes if _IR_TIER_ORDER.get(s.tier, 1) < \
+                _IR_TIER_ORDER.get(prev_tier, 1) else prev_nbytes
+            total += 0.5 * float(fast) * params.hier_boundary_s_per_byte
+        prev_tier, prev_nbytes = s.tier, float(s.nbytes)
+        times.append(t)
+        total += t
+    return (total, times) if per_step else total
+
+
+def program_tier_bytes(program):
+    """Wire bytes a schedule-IR program moves per tier — the
+    worst-case single device's traffic (max over each step's groups,
+    the figure a link is actually sized against), summed over steps.
+    Ring accounting matches :func:`collective_time`: an all-reduce
+    moves ``2(g-1)/g`` of its payload, a half moves ``(g-1)/g``."""
+    out = {}
+    for s in program.steps:
+        if s.op not in ('reduce_scatter', 'all_reduce', 'all_gather'):
+            continue
+        factor = 2.0 if s.op == 'all_reduce' else 1.0
+        b = 0.0
+        for g in s.groups:
+            gs = len(g)
+            if gs <= 1:
+                continue
+            b = max(b, factor * (gs - 1) / gs * float(s.nbytes))
+        if b:
+            out[s.tier] = out.get(s.tier, 0.0) + b
+    return out
 
 
 def strategy_local_steps(strategy):
@@ -552,6 +701,10 @@ class CostReport:
     # local-SGD window length the priced strategy syncs at (H): PS wire
     # terms above are per-STEP averages (the per-round cost / H)
     local_steps: int = 1
+    # every priced schedule entry's IR program passed the shape
+    # algebra (schedule_ir.verify) — a False here means the prediction
+    # priced a schedule that loses or double-counts elements
+    schedule_verified: bool = False
     memory: dict = field(default_factory=dict)
     breakdown: list = field(default_factory=list)
 
@@ -567,6 +720,7 @@ class CostReport:
             'num_collectives': self.num_collectives,
             'num_replicas': self.num_replicas,
             'local_steps': self.local_steps,
+            'schedule_verified': self.schedule_verified,
         }
 
 
@@ -651,13 +805,16 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         params = CostModelParams.from_topology(resource_spec.topology)
     if resource_spec is not None:
         cross_node = resource_spec.topology.multi_node
+    hier_fallback = None
     if nodes is None:
-        nodes = num_node_groups(strategy, resource_spec, n)
+        nodes, hier_fallback = num_node_groups_with_reason(
+            strategy, resource_spec, n)
+        _warn_hier_fallback(hier_fallback)
 
     schedule = static_collective_schedule(
         strategy, graph_item, n,
         sparse_lookups_per_replica=sparse_lookups_per_replica,
-        nodes=nodes, params=params)
+        nodes=nodes, params=params, hier_fallback=hier_fallback)
     breakdown = []
     sync = 0.0
     # grad-phase buckets that ride the backward: all-reduce buckets
@@ -726,6 +883,19 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
     mem = memory_footprint(strategy, graph_item, n,
                            optimizer_slots=optimizer_slots,
                            schedule=schedule)
+    # re-derive each priced entry's IR program and run the shape
+    # algebra on it, so the prediction a strategy is selected by also
+    # certifies the schedule moves every element exactly once
+    from autodist_tpu.parallel import schedule_ir as _sir
+    verified = True
+    for e in schedule:
+        try:
+            if _sir.verify(_sir.entry_program(e, n)):
+                verified = False
+                break
+        except ValueError:
+            verified = False
+            break
     report = CostReport(
         predicted_step_time_s=params.compute_time_s + exposed,
         sync_time_s=sync,
@@ -735,6 +905,7 @@ def predict(strategy, graph_item, resource_spec=None, params=None,
         num_replicas=n,
         cross_node=cross_node,
         local_steps=local_h,
+        schedule_verified=verified,
         memory=mem,
         breakdown=breakdown)
     logging.debug('cost_model.predict: %d collectives, sync=%.3gs '

@@ -151,6 +151,233 @@ def rank(graph_item, resource_spec, candidates=None,
     return feasible, infeasible
 
 
+# -- schedule-IR synthesis ---------------------------------------------
+
+@dataclass
+class ScheduleTopo:
+    """A 3-tier topology schedule synthesis enumerates over.
+
+    ``slices`` is one tuple per slice of per-host device counts —
+    ``((4, 4), (4, 2))`` reads "2 slices; the second has a straggler
+    host with 2 devices". Devices within a host ride ICI, hosts within
+    a slice the ``host`` tier, slices the (slow) DCN tier. ``links``
+    optionally overrides per-tier ``(alpha, beta)`` constants (merged
+    over :func:`calibrate.tier_links`' derivation from the cost-model
+    params)."""
+    slices: tuple = ((1,),)
+    links: dict = None
+
+    def __post_init__(self):
+        self.slices = tuple(tuple(int(g) for g in s)
+                            for s in self.slices)
+
+    @property
+    def host_sizes(self):
+        return tuple(g for s in self.slices for g in s)
+
+    @property
+    def slice_sizes(self):
+        return tuple(sum(s) for s in self.slices)
+
+    @property
+    def num_devices(self):
+        return sum(self.host_sizes)
+
+    @property
+    def uniform(self):
+        hs = self.host_sizes
+        return (len(set(hs)) == 1 and
+                len({len(s) for s in self.slices}) == 1)
+
+
+@dataclass
+class ScheduleCandidate:
+    """One priced + verified schedule-IR candidate."""
+    name: str
+    program: object = None
+    handwritten: bool = True
+    predicted_s: float = 0.0
+    per_step_s: tuple = ()
+    tier_bytes: dict = None
+    staging_bytes: int = 0
+    verify_s: float = 0.0
+    feasible: bool = True
+    error: str = ''
+    rank: int = -1
+
+
+def schedule_candidates(nbytes, dtype='float32', topo=None):
+    """Enumerate IR programs for one ``nbytes`` gradient bucket over
+    ``topo``: first the HAND-WRITTEN shapes ``plan.sync_gradients``
+    can emit today (flat f32/bf16/int8 and, when every host splits
+    equally, the two-level host schedule with its int8 tier boundary),
+    then the SYNTHESIZED shapes only the IR reaches — wave two-level
+    over unequal hosts (lifting ``num_node_groups``' equal-split
+    requirement; the cost model prices the straggler's extra waves),
+    two-level over slices, 3-level device/host/slice, and per-link
+    wire assignment (int8 or bf16 only across the slow tier, f32
+    inside). Returns ``[(name, program, handwritten)]``; shapes a
+    builder rejects (e.g. 3-level on a non-uniform topo) are skipped.
+    """
+    import numpy as np
+    from autodist_tpu.parallel import schedule_ir as sir
+    topo = topo or ScheduleTopo()
+    n = topo.num_devices
+    elems = max(1, int(nbytes) // np.dtype(dtype).itemsize)
+    raw = sir.wire_of_dtype(dtype)
+    out = []
+
+    def add(name, handwritten, build):
+        try:
+            prog = build()
+        except ValueError:
+            return
+        prog.meta['handwritten'] = bool(handwritten)
+        out.append((name, prog, handwritten))
+
+    add('flat/f32', True,
+        lambda: sir.flat_program(elems, dtype, n=n, name='flat/f32'))
+    if raw == 'f32':
+        add('flat/bf16', True,
+            lambda: sir.flat_program(elems, dtype, wire='bf16', n=n,
+                                     name='flat/bf16'))
+        add('flat/i8', True,
+            lambda: sir.flat_program(elems, dtype, wire='i8', n=n,
+                                     name='flat/i8'))
+    hs = topo.host_sizes
+    equal = len(set(hs)) == 1
+    if len(hs) > 1 and n > len(hs):
+        pre, hand = ('two-level/hosts', True) if equal else \
+            ('two-level/hosts/waves', False)
+        add(pre + '/f32', hand,
+            lambda: sir.two_level_program(elems, dtype, hs,
+                                          name=pre + '/f32'))
+        if raw == 'f32':
+            add(pre + '/i8-dcn', hand,
+                lambda: sir.two_level_program(
+                    elems, dtype, hs, wires=(raw, 'i8'),
+                    name=pre + '/i8-dcn'))
+    ss = topo.slice_sizes
+    if len(ss) > 1 and n > len(ss) and ss != hs:
+        add('two-level/slices/f32', False,
+            lambda: sir.two_level_program(
+                elems, dtype, ss, tiers=('host', 'dcn'),
+                name='two-level/slices/f32'))
+        if raw == 'f32':
+            add('two-level/slices/i8-dcn', False,
+                lambda: sir.two_level_program(
+                    elems, dtype, ss, tiers=('host', 'dcn'),
+                    wires=(raw, 'i8'),
+                    name='two-level/slices/i8-dcn'))
+    if topo.uniform and len(topo.slices) > 1 and len(hs) > \
+            len(topo.slices):
+        s, h, g = len(topo.slices), len(topo.slices[0]), hs[0]
+        add('three-level/f32', False,
+            lambda: sir.three_level_program(elems, dtype, s, h, g,
+                                            name='three-level/f32'))
+        if raw == 'f32':
+            add('three-level/i8-dcn', False,
+                lambda: sir.three_level_program(
+                    elems, dtype, s, h, g, wires=(raw, raw, 'i8'),
+                    name='three-level/i8-dcn'))
+            add('three-level/bf16-host-i8-dcn', False,
+                lambda: sir.three_level_program(
+                    elems, dtype, s, h, g,
+                    wires=(raw, 'bf16', 'i8'),
+                    name='three-level/bf16-host-i8-dcn'))
+    return out
+
+
+def rank_schedules(nbytes, dtype='float32', topo=None, params=None,
+                   staging_budget_bytes=None, candidates=None):
+    """Synthesize, VERIFY, and price IR schedules for one gradient
+    bucket; returns ``(feasible, infeasible)``.
+
+    Every feasible candidate passed the shape algebra
+    (:func:`schedule_ir.verify` — a finding kills a candidate, so
+    synthesis can never select a schedule that loses or double-counts
+    elements) and is priced per step by
+    :func:`cost_model.program_time` from the calibrated per-tier α-β
+    (:func:`calibrate.tier_links`, overridden by ``topo.links``).
+    ``staging_budget_bytes`` prunes on requantize/permute staging
+    buffers. The ranking is deterministic: (predicted time, staging
+    bytes, name)."""
+    import time as _time
+    from autodist_tpu.parallel import schedule_ir as sir
+    from autodist_tpu.simulator import calibrate
+    topo = topo or ScheduleTopo()
+    if params is None:
+        params = cost_model.CostModelParams()
+    links = calibrate.tier_links(params)
+    if topo.links:
+        links.update(topo.links)
+    if candidates is None:
+        candidates = schedule_candidates(nbytes, dtype, topo)
+    feasible, infeasible = [], []
+    for name, prog, hand in candidates:
+        cand = ScheduleCandidate(name=name, program=prog,
+                                 handwritten=hand)
+        t0 = _time.perf_counter()
+        findings = sir.verify(prog)
+        cand.verify_s = _time.perf_counter() - t0
+        if findings:
+            cand.feasible = False
+            cand.error = findings[0]
+            logging.warning('simulator: schedule candidate %s failed '
+                            'verification (%s)', name, findings[0])
+            infeasible.append(cand)
+            continue
+        total, per_step = cost_model.program_time(
+            prog, params, links=links, per_step=True)
+        cand.predicted_s = float(total)
+        cand.per_step_s = tuple(per_step)
+        cand.tier_bytes = cost_model.program_tier_bytes(prog)
+        cand.staging_bytes = sir.staging_bytes(prog)
+        if staging_budget_bytes is not None and \
+                cand.staging_bytes > staging_budget_bytes:
+            cand.feasible = False
+            cand.error = ('staging %d B exceeds budget %d B'
+                          % (cand.staging_bytes, staging_budget_bytes))
+            infeasible.append(cand)
+            continue
+        feasible.append(cand)
+    feasible.sort(key=lambda c: (c.predicted_s, c.staging_bytes,
+                                 c.name))
+    for i, c in enumerate(feasible):
+        c.rank = i
+    return feasible, infeasible
+
+
+def best_schedules(feasible):
+    """(best hand-written, best synthesized) of a ranked feasible
+    list — either side None when its class produced no candidate."""
+    hand = next((c for c in feasible if c.handwritten), None)
+    synth = next((c for c in feasible if not c.handwritten), None)
+    return hand, synth
+
+
+def format_schedule_table(feasible, infeasible=()):
+    """Ranked schedule-candidate table (tools/simulate.py
+    --schedule-dump header)."""
+    rows = []
+    header = ('%-4s %-30s %12s %10s %6s %s'
+              % ('#', 'schedule', 'pred (ms)', 'stage(KiB)', 'steps',
+                 'tier bytes'))
+    rows.append(header)
+    rows.append('-' * len(header))
+    for c in feasible:
+        tiers = ' '.join('%s=%.0f' % (t, b)
+                         for t, b in sorted((c.tier_bytes
+                                             or {}).items()))
+        rows.append('%-4d %-30s %12.4f %10.1f %6d %s'
+                    % (c.rank, c.name, c.predicted_s * 1e3,
+                       c.staging_bytes / 1024.0,
+                       len(c.program.steps), tiers))
+    for c in infeasible:
+        rows.append('---  %-30s pruned: %s' % (c.name, c.error))
+    return '\n'.join(rows)
+
+
 def format_ranked_table(feasible, infeasible=()):
     """Human-readable ranked table (tools/simulate.py output)."""
     rows = []

@@ -2993,6 +2993,117 @@ def bench_analysis():
     return out
 
 
+def bench_schedule_ir(steps=8, bucket_bytes=1 << 20):
+    """Collective-schedule IR synthesis A/B (ISSUE 20 acceptance,
+    stable BENCH key ``schedule_ir``).
+
+    ``simulator/search.rank_schedules`` enumerates, shape-verifies
+    (``schedule_ir.verify``), and prices every hand-written and
+    synthesized IR schedule for ONE gradient bucket over this mesh
+    factored as 2 slices x 2 hosts — the smallest topology where
+    synthesis reaches shapes the hand-written emitter cannot
+    (two-level over slices, 3-level device/host/slice, per-link wire
+    assignment). The ranked-best candidate of EACH class is then
+    executed on the live mesh (``schedule_ir.execute`` under pmap) so
+    the record carries measured per-step sync time NEXT TO the cost
+    model's per-step prediction, plus per-tier byte totals, the
+    verification wall across all candidates, and the max abs diff of
+    the two synced states (pure re-association + wire quantization).
+    A class whose ranked best cannot trace on a CPU mesh (int8 wire in
+    a generic program) falls back to its best executable candidate —
+    ``executed`` names what actually ran. ``state_max_abs_diff`` of -1
+    is the failure sentinel: a leg never produced a synced state.
+
+    Never raises: meshes that cannot factor into 2 slices x 2 hosts
+    degrade to an ``{'error': ...}`` entry so the bench still emits
+    its one JSON line.
+    """
+    try:
+        return _bench_schedule_ir_inner(steps, bucket_bytes)
+    except Exception as e:   # noqa: BLE001 - record must still emit
+        return {'error': '%s: %s' % (type(e).__name__, e)}
+
+
+def _bench_schedule_ir_inner(steps, bucket_bytes):
+    import jax
+
+    from autodist_tpu.parallel import schedule_ir as sir
+    from autodist_tpu.simulator import search
+
+    devs = probed_devices()
+    n = len(devs)
+    if n < 4 or n % 4:
+        return {'error': 'mesh of %d devices cannot factor into '
+                         '2 slices x 2 hosts' % n}
+    topo = search.ScheduleTopo(slices=((n // 4, n // 4),) * 2)
+    feasible, infeasible = search.rank_schedules(
+        bucket_bytes, 'float32', topo)
+    hand, synth = search.best_schedules(feasible)
+    if hand is None or synth is None:
+        return {'error': 'ranking produced no %s candidate'
+                         % ('hand-written' if hand is None
+                            else 'synthesized')}
+
+    rng = np.random.default_rng(20)
+    grads = jax.device_put_sharded(
+        list(rng.standard_normal((n, bucket_bytes // 4))
+             .astype(np.float32)), devs)
+
+    def _measure(ranked):
+        # best candidate of the class that can trace on this mesh
+        for c in ranked:
+            prog = c.program
+            if sir.lowering_of(prog) == 'generic' and \
+                    not sir.executable_generic(prog):
+                continue
+            try:
+                f = jax.pmap(lambda x, p=prog: sir.execute(p, x, 'i'),
+                             axis_name='i', devices=devs)
+                med, outs = _time_sync_program(f, (grads,), steps)
+            except Exception:   # noqa: BLE001 - try the next shape
+                continue
+            return c.name, round(med / steps, 6), np.asarray(outs[0])
+        return None, -1.0, None
+
+    hand_name, hand_step, hand_out = _measure(
+        [c for c in feasible if c.handwritten])
+    synth_name, synth_step, synth_out = _measure(
+        [c for c in feasible if not c.handwritten])
+
+    def _side(best, executed, measured):
+        return {
+            'best': best.name,
+            'predicted_s': round(best.predicted_s, 9),
+            'per_step_pred_s': [round(t, 9)
+                                for t in best.per_step_s],
+            'tier_bytes': {t: int(b) for t, b
+                           in (best.tier_bytes or {}).items()},
+            'staging_bytes': int(best.staging_bytes),
+            'verify_s': round(best.verify_s, 6),
+            'executed': executed,
+            'measured_per_step_s': measured,
+        }
+
+    diff = -1.0
+    if hand_out is not None and synth_out is not None:
+        diff = float(np.abs(hand_out - synth_out).max())
+    return {
+        'devices': n,
+        'topo': [list(s) for s in topo.slices],
+        'bucket_bytes': int(bucket_bytes),
+        'candidates': len(feasible),
+        'pruned': len(infeasible),
+        'verify_total_s': round(sum(c.verify_s for c in
+                                    feasible + infeasible), 6),
+        'predicted_speedup': round(hand.predicted_s /
+                                   synth.predicted_s, 3)
+        if synth.predicted_s else 0.0,
+        'handwritten': _side(hand, hand_name, hand_step),
+        'synthesized': _side(synth, synth_name, synth_step),
+        'state_max_abs_diff': diff,
+    }
+
+
 def bench_scaling(steps=5):
     """Multi-device scaling: the same workload at dp=1 and dp=n on this
     process's device set (virtual CPU mesh or a real pod slice).
@@ -3132,6 +3243,7 @@ def main():
         result['extra']['telemetry'] = telemetry_rec
         result['extra']['monitor'] = bench_monitor()
         result['extra']['analysis'] = bench_analysis()
+        result['extra']['schedule_ir'] = bench_schedule_ir()
         print(json.dumps(result))
         return
     n = max(1, len(devices))
@@ -3162,6 +3274,7 @@ def main():
     telemetry_rec['sim_drift'] = _sim_drift(simulator)
     monitor_rec = bench_monitor()
     analysis_rec = bench_analysis()
+    schedule_ir_rec = bench_schedule_ir()
     longctx = bench_longctx(10) if on_tpu else None
     sparse = bench_sparse(steps) if on_tpu else None
 
@@ -3191,6 +3304,7 @@ def main():
                 'telemetry': telemetry_rec,
                 'monitor': monitor_rec,
                 'analysis': analysis_rec,
+                'schedule_ir': schedule_ir_rec,
                 'resnet101_img_per_sec_per_chip': round(img_ps, 1),
                 'resnet101_vs_baseline': round(
                     img_ps / RESNET101_BASELINE_IMG_PER_SEC_PER_CHIP, 3),
@@ -3255,7 +3369,8 @@ def main():
                       'roofline': roofline,
                       'telemetry': telemetry_rec,
                       'monitor': monitor_rec,
-                      'analysis': analysis_rec},
+                      'analysis': analysis_rec,
+                      'schedule_ir': schedule_ir_rec},
         }
     print(json.dumps(result))
 

@@ -617,34 +617,50 @@ def test_schedule_lint_head_clean():
 
 
 def test_schedule_lint_flags_emission_drift():
-    """Dropping the hierarchical knob from ONE side's fusion key (the
-    exact class of asymmetric edit the static==traced pin can miss on
-    uncovered fixtures) must be a finding."""
+    """An emitter that stops routing through the shared IR lowering
+    (the exact class of asymmetric edit the static==traced pin can
+    miss on uncovered fixtures) must be a finding."""
     from autodist_tpu.analysis import schedule_lint
     src = open(schedule_lint.PLAN_SRC).read()
+    # traced side inlines its own fusion key instead of the shared one
     drifted = src.replace(
-        "key = (plan.group, type(plan.compressor).__name__,\n"
-        "                       str(grad.dtype), plan.spec, "
-        "plan.hierarchical,\n"
-        "                       plan.weight_update_sharding)",
-        "key = (plan.group, type(plan.compressor).__name__,\n"
-        "                       str(grad.dtype), plan.spec,\n"
-        "                       plan.weight_update_sharding)")
+        "fusable.setdefault(bucket_fusion_key(plan, grad.dtype),\n"
+        "                                   []).append(i)",
+        "fusable.setdefault((plan.group, str(grad.dtype)),\n"
+        "                                   []).append(i)")
     assert drifted != src
     findings = schedule_lint.check_emission_predicates(drifted)
-    assert any('fusion keys DRIFTED' in f for f in findings)
-    # and widening only one side's fusable set is a finding too
+    assert any('bucket_fusion_key' in f for f in findings)
+    # static side inlines its own fusable predicate
     drifted2 = src.replace(
-        '(type(plan.compressor) in (comp.NoneCompressor,\n'
-        '                                           comp.HorovodCompressor) or\n'
-        '                 comp.int8_bucket_fusable(plan.compressor, var.dtype,\n'
-        '                                          size))',
-        '(type(plan.compressor) in (comp.NoneCompressor,) or\n'
-        '                 comp.int8_bucket_fusable(plan.compressor, var.dtype,\n'
-        '                                          size))')
+        'elif bucket_fusable(plan, var.dtype, size):',
+        'elif plan.is_ar and plan.group is not None:')
     assert drifted2 != src
     findings = schedule_lint.check_emission_predicates(drifted2)
-    assert any('fusable predicates DRIFTED' in f for f in findings)
+    assert any('bucket_fusable' in f for f in findings)
+    # a traced helper hand-rolling its collective bypasses the IR
+    drifted3 = src.replace(
+        'return sir.execute(prog, g, AXIS_DATA)',
+        'return ring_all_reduce(g, AXIS_DATA) / n')
+    assert drifted3 != src
+    findings = schedule_lint.check_emission_predicates(drifted3)
+    assert any('schedule_ir.execute' in f for f in findings)
+
+
+def test_schedule_lint_ir_algebra_and_sensitivity():
+    """The IR sweep explores clean on HEAD, and the seeded wrong
+    schedule (int8 boundary requantize moved inside the ICI phase)
+    still produces its finding — the sensitivity guard that justifies
+    trusting the clean run."""
+    from autodist_tpu.analysis import schedule_lint
+    from autodist_tpu.parallel import schedule_ir as sir
+    assert schedule_lint.check_ir_algebra() == []
+    bad = schedule_lint.seeded_counterexample()
+    findings = sir.verify(bad)
+    assert any('requantize' in f for f in findings), findings
+    assert schedule_lint.check_ir_sensitivity() == []
+    # pricing parity: program_time over the IR tracks entry_time
+    assert schedule_lint.check_pricing_parity() == []
 
 
 def test_schedule_lint_flags_update_sharding_drift():
@@ -656,9 +672,8 @@ def test_schedule_lint_flags_update_sharding_drift():
     from autodist_tpu.analysis import schedule_lint
     src = open(schedule_lint.PLAN_SRC).read()
     # static side loses the wus tag on its emitted pair
-    drifted = src.replace(
-        "'phase': phase, 'hier': hier, 'wus': True})",
-        "'phase': phase, 'hier': hier})")
+    drifted = src.replace('spec, n, hier=hier, wus=True)',
+                          'spec, n, hier=hier)')
     assert drifted != src
     findings = schedule_lint.check_emission_predicates(drifted)
     assert any('wus tag' in f for f in findings)

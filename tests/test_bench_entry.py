@@ -211,6 +211,22 @@ def test_bench_cpu_smoke_emits_one_json_line():
     assert an['states_explored_total'] >= sum(
         an['passes'][p]['states_explored']
         for p in ('protocol', 'data-plane', 'epoch-swap'))
+    # ISSUE 20: the collective-schedule-IR A/B under its stable key —
+    # candidates synthesized + shape-verified + priced, and the best
+    # of each class actually executed on the mesh
+    si = extra['schedule_ir']
+    assert 'error' not in si, si
+    assert si['devices'] == 8 and si['candidates'] > 0, si
+    for side in ('handwritten', 'synthesized'):
+        leg = si[side]
+        assert leg['predicted_s'] > 0 and leg['tier_bytes'], leg
+        assert leg['executed'] and leg['measured_per_step_s'] > 0, leg
+        assert leg['verify_s'] >= 0 and leg['per_step_pred_s'], leg
+    assert si['verify_total_s'] > 0, si
+    # both legs synced the same seeded bucket: divergence is bounded
+    # by one wire-quantization step, and -1 (a leg failed) must never
+    # appear on a healthy mesh
+    assert 0.0 <= si['state_max_abs_diff'] < 0.1, si
 
 
 def test_bench_unavailable_backend_falls_back_to_cpu(monkeypatch):

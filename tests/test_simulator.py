@@ -87,6 +87,10 @@ def test_predict_golden_single_var_allreduce():
     # (single-var bucket)
     assert rep.predicted_peak_bytes == 16 * MiB
     assert rep.memory['bucket_staging_bytes'] == 0
+    # every priced entry's IR program passed the shape algebra, and
+    # the certificate rides Strategy.cost via summary()
+    assert rep.schedule_verified is True
+    assert rep.summary()['schedule_verified'] is True
 
 
 def test_wire_bytes_compressors():

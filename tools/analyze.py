@@ -31,9 +31,14 @@ docs/design/static-analysis.md):
               tools/check_protocol.py)
   env         AUTODIST_* env reads declared + worker knobs forwarded
               + docs mention every knob (choice sets in sync)
-  schedule    sync_gradients vs static_collective_schedule emission
-              predicates, reshard shape algebra, wire-pricing drift
-              (absorbs tools/check_wire_pricing.py)
+  schedule    schedule-IR shape algebra run ONCE over every
+              emitter-reachable dimension combination (with a seeded
+              wrong-schedule counterexample as the sensitivity
+              guard), a thin routes-through-the-IR drift check on
+              both emission paths, program_time/entry_time pricing
+              parity, reshard shape algebra (each move verified via
+              its own IR program), wire-pricing drift (absorbs
+              tools/check_wire_pricing.py)
 
 ``--conformance <dump>...`` is the dynamic twin (docs/design/
 observability.md): it replays the crash flight recorder's event trace
@@ -141,7 +146,8 @@ def main(argv=None):
                     help='AUTODIST_* env-knob lint (declaration, '
                          'forwarding, docs drift)')
     ap.add_argument('--schedule', action='store_true',
-                    help='schedule/plan consistency lint')
+                    help='schedule-IR shape-algebra verification + '
+                         'routes-through-IR drift lint')
     ap.add_argument('--json', action='store_true',
                     help='print a machine-readable JSON report')
     ap.add_argument('--conformance', nargs='+', metavar='DUMP',

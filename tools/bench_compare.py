@@ -152,6 +152,29 @@ METRICS = (
      'HBM estimate drift |ratio-1|', 5),
     ('roofline', 'extra.roofline.drift.worst_drift_ratio', 'lower',
      'worst per-entry collective drift', 5),
+    # the collective-schedule-IR trajectory (ISSUE 20): the predicted
+    # speedup, per-tier bytes, and verification wall are deterministic
+    # cost-model/shape-algebra outputs (normal threshold; the verify
+    # wall is sub-millisecond interpreter work, so it rides the wide
+    # scale anyway); the measured per-step syncs are CPU-mesh
+    # collective timings (5x scale). state_max_abs_diff is the
+    # synth-vs-hand synced-state divergence — seeded grads make the
+    # wire-quantization error deterministic, and -1 is the failure
+    # sentinel (a leg never produced a synced state).
+    ('schedule_ir', 'extra.schedule_ir.predicted_speedup', 'higher',
+     'synthesized-vs-hand-written predicted schedule speedup'),
+    ('schedule_ir', 'extra.schedule_ir.synthesized.tier_bytes.dcn',
+     'lower', 'synthesized-best DCN bytes per step'),
+    ('schedule_ir', 'extra.schedule_ir.verify_total_s', 'lower',
+     'schedule-IR verification wall (all candidates)', 5),
+    ('schedule_ir',
+     'extra.schedule_ir.handwritten.measured_per_step_s', 'lower',
+     'hand-written-best measured per-step sync', 5),
+    ('schedule_ir',
+     'extra.schedule_ir.synthesized.measured_per_step_s', 'lower',
+     'synthesized-best measured per-step sync', 5),
+    ('schedule_ir', 'extra.schedule_ir.state_max_abs_diff', 'lower',
+     'synth-vs-hand synced-state divergence (-1 = leg failed)'),
 )
 
 

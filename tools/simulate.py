@@ -156,6 +156,24 @@ def main(argv=None):
                    choices=('f32', 'bf16', 'i8'),
                    help='wire dtype of the bulk snapshot pull '
                         '(AUTODIST_SERVE_WIRE)')
+    p.add_argument('--schedule-dump', action='store_true',
+                   dest='schedule_dump',
+                   help='rank schedule-IR candidates (hand-written + '
+                        'synthesized) for one gradient bucket over '
+                        '--schedule-topo and print each program with '
+                        'per-step predicted times and per-tier byte '
+                        'totals — the WHY behind the winning schedule')
+    p.add_argument('--schedule-topo', default='',
+                   dest='schedule_topo',
+                   help='topology for --schedule-dump as per-host '
+                        'device counts, slices separated by "/" '
+                        '(e.g. "4,4/4,2" = 2 slices, the second with '
+                        'a 2-device straggler host). Default: one '
+                        'slice shaped like the resource spec')
+    p.add_argument('--schedule-bytes', type=int, default=0,
+                   dest='schedule_bytes',
+                   help='bucket size for --schedule-dump (default: '
+                        'the model\'s total dense gradient bytes)')
     p.add_argument('--json', action='store_true',
                    help='emit one JSON object instead of the table')
     args = p.parse_args(argv)
@@ -228,6 +246,30 @@ def main(argv=None):
             compressor=wire_comp)
         serving['wire'] = args.serve_wire
 
+    schedules = None
+    if args.schedule_dump:
+        import numpy as np
+        if args.schedule_topo:
+            try:
+                slices = tuple(
+                    tuple(int(g) for g in s.split(','))
+                    for s in args.schedule_topo.split('/'))
+            except ValueError:
+                raise SystemExit('--schedule-topo must look like '
+                                 '"4,4/4,2"; got %r'
+                                 % args.schedule_topo)
+        else:
+            per_node = rs.node_accelerator_devices or \
+                {a: [0] for a in rs.nodes}
+            slices = (tuple(len(v) for v in per_node.values()),)
+        topo = search.ScheduleTopo(slices=slices)
+        sbytes = args.schedule_bytes or sum(
+            int(np.prod(v.shape or (1,))) * np.dtype(v.dtype).itemsize
+            for v in gi.trainable_var_op_to_var.values())
+        schedules = (topo, sbytes) + tuple(search.rank_schedules(
+            sbytes, 'float32', topo, params,
+            staging_budget_bytes=budget))
+
     def cand_json(feas, infeas):
         return [dict(c.strategy.cost, feasible=True) for c in feas] + \
             [{'builder': c.name, 'feasible': False, 'error': c.error}
@@ -244,6 +286,23 @@ def main(argv=None):
             out['candidates_flat'] = cand_json(*flat)
         if serving is not None:
             out['serving'] = serving
+        if schedules is not None:
+            topo, sbytes, sf, si = schedules
+            out['schedules'] = {
+                'topo': [list(s) for s in topo.slices],
+                'bucket_bytes': sbytes,
+                'candidates': [
+                    {'name': c.name, 'rank': c.rank, 'feasible': True,
+                     'handwritten': c.handwritten,
+                     'predicted_s': c.predicted_s,
+                     'per_step_s': list(c.per_step_s),
+                     'tier_bytes': c.tier_bytes,
+                     'staging_bytes': c.staging_bytes,
+                     'verify_s': c.verify_s,
+                     'program': c.program.to_dict()} for c in sf] +
+                [{'name': c.name, 'feasible': False, 'error': c.error}
+                 for c in si],
+            }
         print(json.dumps(out))
         return 0
     print('model=%s  vars=%d  %r  replicas=%d%s' % (
@@ -257,6 +316,19 @@ def main(argv=None):
     if flat is not None:
         print('-- flat-forced ranking (every bucket a flat ring) --')
         print(search.format_ranked_table(*flat))
+    if schedules is not None:
+        topo, sbytes, sf, si = schedules
+        from autodist_tpu.parallel import schedule_ir as sir
+        from autodist_tpu.simulator.calibrate import tier_links
+        links = tier_links(params)
+        if topo.links:
+            links.update(topo.links)
+        print('-- schedule-IR candidates: %.2f MiB bucket over '
+              'slices %s --' % (sbytes / (1 << 20),
+                                [list(s) for s in topo.slices]))
+        print(search.format_schedule_table(sf, si))
+        for c in sf:
+            print(sir.format_program(c.program, params, links=links))
     if serving is not None:
         print('serving: %d replica(s) @ %.1f polls/s on the %s wire  '
               'snapshot %.2fMB/pull (%.1fms)  fleet %.2fMB/s '
